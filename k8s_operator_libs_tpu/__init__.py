@@ -1,0 +1,19 @@
+"""tpu-operator-libs: TPU-native Kubernetes operator library.
+
+A brand-new framework with the capabilities of the reference
+`k8s-operator-libs` (NVIDIA's GPU/NIC driver-upgrade library, see
+/root/reference — SURVEY.md for the structural analysis), redesigned for
+Google TPU node pools as a first-class device class:
+
+- the cluster-wide, label-driven, idempotent upgrade state machine
+  (reference: pkg/upgrade/upgrade_state.go:102-120) becomes **ICI-slice
+  aware** — the schedulable upgrade unit is a whole multi-host TPU slice
+  that must move atomically so the torus is never split;
+- the validation layer (reference: pkg/upgrade/validation_manager.go)
+  becomes a JAX/XLA health backend probing device enumeration, MXU
+  matmuls, HBM bandwidth and ICI all-reduce reachability;
+- the NVIDIA driver-container assumption is replaced by a libtpu
+  device-plugin reconciler.
+"""
+
+__version__ = "0.1.0"

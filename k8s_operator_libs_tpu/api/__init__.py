@@ -1,0 +1,12 @@
+"""CRD-embeddable policy types (analogue of the reference's ``api/upgrade``)."""
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    IntOrString,
+    PodDeletionSpec,
+    SliceHealthGateSpec,
+    SliceTopologySpec,
+    TPUUpgradePolicySpec,
+    WaitForCompletionSpec,
+)

@@ -1,0 +1,323 @@
+"""v1alpha1 policy API: CRD-embeddable upgrade policy types.
+
+Capability parity with the reference's
+``api/upgrade/v1alpha1/upgrade_spec.go:27-110`` (DriverUpgradePolicySpec,
+WaitForCompletionSpec, PodDeletionSpec, DrainSpec with kubebuilder
+defaults/validation) and ``zz_generated.deepcopy.go`` (deep-copy), plus the
+TPU-native extensions specified in SURVEY.md §7 step 1: slice topology,
+slice-atomicity mode, ICI health gate, and slice-granular unavailability.
+
+Types serialize to/from the same camelCase JSON shape a consumer operator
+would embed in its CRD, so a policy YAML written for the reference loads
+unchanged into :class:`DriverUpgradePolicySpec`.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import re
+from dataclasses import asdict, dataclass, field, fields
+from typing import Any, Optional, Union
+
+
+class ValidationError(ValueError):
+    """Raised when a spec violates its (kubebuilder-style) validation rules."""
+
+
+# ---------------------------------------------------------------------------
+# IntOrString — analogue of k8s.io/apimachinery/pkg/util/intstr
+# ---------------------------------------------------------------------------
+
+_PERCENT_RE = re.compile(r"^(\d+)%$")
+
+
+@dataclass(frozen=True)
+class IntOrString:
+    """An int count or a percentage string like ``"25%"``.
+
+    Mirrors apimachinery's intstr type as used by MaxUnavailable
+    (reference upgrade_spec.go:39-45).
+    """
+
+    value: Union[int, str]
+
+    def __post_init__(self) -> None:
+        if isinstance(self.value, str) and not _PERCENT_RE.match(self.value):
+            raise ValidationError(
+                f"invalid IntOrString {self.value!r}: string form must be 'N%'"
+            )
+        if isinstance(self.value, int) and self.value < 0:
+            raise ValidationError("IntOrString int form must be >= 0")
+
+    def scaled_value(self, total: int, round_up: bool = True) -> int:
+        """Resolve to an absolute count against ``total``.
+
+        Analogue of ``intstr.GetScaledValueFromIntOrPercent`` as called at
+        reference upgrade_state.go:395-401 (percentage rounds up).
+        """
+        if isinstance(self.value, int):
+            return self.value
+        pct = int(_PERCENT_RE.match(self.value).group(1))
+        if round_up:
+            return math.ceil(pct * total / 100)
+        return math.floor(pct * total / 100)
+
+    @classmethod
+    def parse(cls, raw: Union[int, str, "IntOrString"]) -> "IntOrString":
+        if isinstance(raw, IntOrString):
+            return raw
+        return cls(raw)
+
+
+# ---------------------------------------------------------------------------
+# Spec base with camelCase JSON round-trip + deep copy
+# ---------------------------------------------------------------------------
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+_JSON_NAME_OVERRIDES = {
+    # Reference upgrade_spec.go:48: field DrainSpec serializes as "drain".
+    "drain_spec": "drain",
+    # Reference upgrade_spec.go:63,77,104: TimeoutSecond -> "timeoutSeconds".
+    "timeout_second": "timeoutSeconds",
+}
+
+
+class _SpecBase:
+    """camelCase JSON (de)serialization + deep-copy for all spec types."""
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            key = _JSON_NAME_OVERRIDES.get(f.name, _camel(f.name))
+            if isinstance(v, _SpecBase):
+                out[key] = v.to_dict()
+            elif isinstance(v, IntOrString):
+                out[key] = v.value
+            else:
+                out[key] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "Any":
+        kwargs: dict[str, Any] = {}
+        by_json_name = {}
+        for f in fields(cls):
+            by_json_name[_JSON_NAME_OVERRIDES.get(f.name, _camel(f.name))] = f
+        for key, raw in (data or {}).items():
+            f = by_json_name.get(key)
+            if f is None:
+                continue  # tolerate unknown fields like the apiserver does
+            typ = _NESTED_TYPES.get((cls.__name__, f.name))
+            if typ is not None and raw is not None:
+                kwargs[f.name] = typ.from_dict(raw)
+            elif f.name == "max_unavailable" and raw is not None:
+                kwargs[f.name] = IntOrString.parse(raw)
+            else:
+                kwargs[f.name] = raw
+        return cls(**kwargs)
+
+    def deep_copy(self):
+        """Analogue of the controller-gen DeepCopy (zz_generated.deepcopy.go)."""
+        return copy.deepcopy(self)
+
+    def validate(self) -> None:  # overridden where rules exist
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Reference-parity specs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WaitForCompletionSpec(_SpecBase):
+    """Wait-for-job-completion configuration (upgrade_spec.go:51-64)."""
+
+    pod_selector: str = ""
+    # 0 means wait forever.
+    timeout_second: int = 0
+
+    def validate(self) -> None:
+        if self.timeout_second < 0:
+            raise ValidationError("waitForCompletion.timeoutSeconds must be >= 0")
+
+
+@dataclass
+class PodDeletionSpec(_SpecBase):
+    """Workload pod deletion configuration (upgrade_spec.go:66-83)."""
+
+    force: bool = False
+    timeout_second: int = 300
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        if self.timeout_second < 0:
+            raise ValidationError("podDeletion.timeoutSeconds must be >= 0")
+
+
+@dataclass
+class DrainSpec(_SpecBase):
+    """Node drain configuration (upgrade_spec.go:85-110)."""
+
+    enable: bool = False
+    force: bool = False
+    pod_selector: str = ""
+    timeout_second: int = 300
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        if self.timeout_second < 0:
+            raise ValidationError("drain.timeoutSeconds must be >= 0")
+
+
+@dataclass
+class DriverUpgradePolicySpec(_SpecBase):
+    """Automatic-upgrade policy (upgrade_spec.go:24-49).
+
+    Defaults mirror the reference's kubebuilder markers: autoUpgrade=false,
+    maxParallelUpgrades=1 (0 = unlimited), maxUnavailable="25%".
+    """
+
+    auto_upgrade: bool = False
+    max_parallel_upgrades: int = 1
+    max_unavailable: Optional[IntOrString] = field(
+        default_factory=lambda: IntOrString("25%")
+    )
+    pod_deletion: Optional[PodDeletionSpec] = None
+    wait_for_completion: Optional[WaitForCompletionSpec] = None
+    drain_spec: Optional[DrainSpec] = None
+
+    def validate(self) -> None:
+        if self.max_parallel_upgrades < 0:
+            raise ValidationError("maxParallelUpgrades must be >= 0")
+        for sub in (self.pod_deletion, self.wait_for_completion, self.drain_spec):
+            if sub is not None:
+                sub.validate()
+
+
+# ---------------------------------------------------------------------------
+# TPU-native extensions (new; SURVEY.md §7 step 1)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SliceTopologySpec(_SpecBase):
+    """Explicit slice-topology override.
+
+    Normally slice membership is discovered from GKE TPU node labels
+    (cloud.google.com/gke-tpu-topology et al.); this spec lets a consumer
+    pin the expectation so discovery drift fails loudly.
+    """
+
+    # e.g. "tpu-v5p-slice"
+    accelerator: str = ""
+    # Chip topology string, e.g. "2x2x4" (v5p-16: 8 chips? no — chips) —
+    # product of dims = chips in the slice.
+    topology: str = ""
+    # Hosts forming one ICI domain; 0 = derive from topology/accelerator.
+    hosts_per_slice: int = 0
+
+    _TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+    def validate(self) -> None:
+        if self.topology and not self._TOPOLOGY_RE.match(self.topology):
+            raise ValidationError(
+                f"topology {self.topology!r} must look like '2x2x4'"
+            )
+        if self.hosts_per_slice < 0:
+            raise ValidationError("hostsPerSlice must be >= 0")
+
+    def chips(self) -> int:
+        if not self.topology:
+            return 0
+        dims = [int(d) for d in self.topology.split("x")]
+        n = 1
+        for d in dims:
+            n *= d
+        return n
+
+
+@dataclass
+class SliceHealthGateSpec(_SpecBase):
+    """ICI/XLA health gate run in the validation state (new component).
+
+    Replaces the reference's out-of-repo nvidia-smi validation pods
+    (SURVEY.md §5 'Collective-health probing'): "validated" means the slice
+    re-formed completely and an XLA all-reduce over ICI completes.
+    """
+
+    enable: bool = True
+    # Seconds to wait for one all-reduce probe before declaring it hung.
+    all_reduce_timeout_second: int = 60
+    # Fraction of expected devices that must re-enumerate; north star = 1.0.
+    min_reformation_fraction: float = 1.0
+    # Also probe DCN reachability between slices of one multi-slice group.
+    dcn_check: bool = False
+    # Overall validation deadline before the slice is marked failed
+    # (reference validation_manager.go:32 uses a fixed 600s).
+    timeout_second: int = 600
+
+    def validate(self) -> None:
+        if not (0.0 <= self.min_reformation_fraction <= 1.0):
+            raise ValidationError("minReformationFraction must be in [0, 1]")
+        if self.all_reduce_timeout_second < 0 or self.timeout_second < 0:
+            raise ValidationError("health gate timeouts must be >= 0")
+
+
+@dataclass
+class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
+    """Slice-aware upgrade policy for TPU node pools.
+
+    Extends the reference policy with the TPU north-star fields:
+
+    - ``slice_atomic``: all hosts of one ICI domain transition as a unit —
+      the torus is never split (SURVEY.md §7 step 2);
+    - ``unavailability_unit``: whether maxParallelUpgrades/maxUnavailable
+      count slices or individual hosts;
+    - ``health_gate``: the ICI/XLA validation gate;
+    - ``dcn_anti_affinity``: never take two slices of the same DCN
+      (multi-slice data-parallel) group down simultaneously.
+    """
+
+    slice_atomic: bool = True
+    # "slice" or "node".
+    unavailability_unit: str = "slice"
+    topology: Optional[SliceTopologySpec] = None
+    health_gate: Optional[SliceHealthGateSpec] = field(
+        default_factory=SliceHealthGateSpec
+    )
+    dcn_anti_affinity: bool = True
+
+    def validate(self) -> None:
+        super().validate()
+        if self.unavailability_unit not in ("slice", "node"):
+            raise ValidationError(
+                "unavailabilityUnit must be 'slice' or 'node', got "
+                f"{self.unavailability_unit!r}"
+            )
+        if self.topology is not None:
+            self.topology.validate()
+        if self.health_gate is not None:
+            self.health_gate.validate()
+
+
+# Nested-type registry for from_dict (maps (class, field) -> spec type).
+_NESTED_TYPES: dict[tuple[str, str], Any] = {
+    ("DriverUpgradePolicySpec", "pod_deletion"): PodDeletionSpec,
+    ("DriverUpgradePolicySpec", "wait_for_completion"): WaitForCompletionSpec,
+    ("DriverUpgradePolicySpec", "drain_spec"): DrainSpec,
+    ("TPUUpgradePolicySpec", "pod_deletion"): PodDeletionSpec,
+    ("TPUUpgradePolicySpec", "wait_for_completion"): WaitForCompletionSpec,
+    ("TPUUpgradePolicySpec", "drain_spec"): DrainSpec,
+    ("TPUUpgradePolicySpec", "topology"): SliceTopologySpec,
+    ("TPUUpgradePolicySpec", "health_gate"): SliceHealthGateSpec,
+}

@@ -1,0 +1,368 @@
+"""In-memory Kubernetes apiserver: the envtest analogue.
+
+The reference's test/bench substrate is envtest — a real kube-apiserver +
+etcd with nothing running behind it (SURVEY.md §4).  This module provides
+the same trick natively: :class:`FakeCluster` stores typed objects and
+implements the exact API semantics the engine depends on —
+
+- strategic-merge label patches / merge-patch annotations with ``null``
+  deletes (node_upgrade_state_provider.go:80,147-150),
+- label + field selectors on list calls,
+- DaemonSet ControllerRevision hashes,
+- the Eviction API path used by drain,
+- **configurable cache lag**: reads are served through an optionally
+  stale cache, reproducing the controller-runtime cache-coherency
+  problem the reference's write-then-poll loop exists to solve
+  (node_upgrade_state_provider.go:92-117),
+- **configurable per-call latency** and per-verb call counters, so
+  bench.py can model apiserver round-trip cost.
+
+Everything is thread-safe: the engine's drain/pod managers run per-slice
+worker threads against this client, like the reference's goroutines run
+against envtest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, defaultdict
+from typing import Callable, Iterable, Optional
+
+from k8s_operator_libs_tpu.k8s.objects import (
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    NodeCondition,
+    ObjectMeta,
+    Pod,
+    deep_copy,
+)
+from k8s_operator_libs_tpu.k8s.selectors import (
+    matches_labels,
+    matches_selector,
+)
+
+
+class NotFoundError(KeyError):
+    """Object does not exist (or is not yet visible in the read cache)."""
+
+
+class ConflictError(RuntimeError):
+    pass
+
+
+_HISTORY_CAP = 64
+
+
+class _Store:
+    """One kind's storage with per-key write history for cache-lag reads."""
+
+    def __init__(self) -> None:
+        self.objs: dict = {}
+        # key -> [(monotonic_ts, snapshot-or-None)]; None = deleted
+        self.history: dict = defaultdict(list)
+
+    def put(self, key, obj) -> None:
+        obj.metadata.resource_version += 1
+        self.objs[key] = obj
+        h = self.history[key]
+        h.append((time.monotonic(), deep_copy(obj)))
+        if len(h) > _HISTORY_CAP:
+            del h[: len(h) - _HISTORY_CAP]
+
+    def delete(self, key) -> None:
+        self.objs.pop(key, None)
+        self.history[key].append((time.monotonic(), None))
+
+    def get_live(self, key):
+        return self.objs.get(key)
+
+    def get_cached(self, key, lag_s: float):
+        """Newest snapshot at least ``lag_s`` old; None if not yet visible."""
+        if lag_s <= 0:
+            return self.objs.get(key)
+        cutoff = time.monotonic() - lag_s
+        chosen = None
+        for ts, snap in self.history.get(key, ()):  # oldest -> newest
+            if ts <= cutoff:
+                chosen = snap
+            else:
+                break
+        return chosen
+
+
+class FakeCluster:
+    """In-memory apiserver + object store (see module docstring)."""
+
+    def __init__(self, api_latency_s: float = 0.0, cache_lag_s: float = 0.0):
+        self._lock = threading.RLock()
+        self._nodes = _Store()
+        self._pods = _Store()
+        self._daemon_sets = _Store()
+        self._revisions = _Store()
+        self.api_latency_s = api_latency_s
+        self.cache_lag_s = cache_lag_s
+        # verb -> count; exposed for bench round-trip accounting
+        self.stats: Counter = Counter()
+        self._pod_deleted_hooks: list[Callable[[Pod], None]] = []
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, verb: str) -> None:
+        self.stats[verb] += 1
+        if self.api_latency_s > 0:
+            time.sleep(self.api_latency_s)
+
+    def on_pod_deleted(self, hook: Callable[[Pod], None]) -> None:
+        """Register a hook fired after a pod is deleted/evicted (lets tests
+        and bench emulate the DaemonSet controller recreating driver pods)."""
+        self._pod_deleted_hooks.append(hook)
+
+    # -- nodes -------------------------------------------------------------
+
+    def create_node(self, node: Node) -> Node:
+        self._call("create_node")
+        with self._lock:
+            if self._nodes.get_live(node.name) is not None:
+                raise ConflictError(f"node {node.name} exists")
+            self._nodes.put(node.name, node)
+            return deep_copy(node)
+
+    def get_node(self, name: str, cached: bool = True) -> Node:
+        """Read a node. ``cached=True`` models the controller-runtime cache
+        (subject to cache lag); ``cached=False`` is a quorum read."""
+        self._call("get_node")
+        with self._lock:
+            obj = (
+                self._nodes.get_cached(name, self.cache_lag_s)
+                if cached
+                else self._nodes.get_live(name)
+            )
+            if obj is None:
+                raise NotFoundError(f"node {name}")
+            return deep_copy(obj)
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        self._call("list_nodes")
+        with self._lock:
+            return [
+                deep_copy(n)
+                for n in self._nodes.objs.values()
+                if matches_selector(n.labels, label_selector)
+            ]
+
+    def patch_node_labels(self, name: str, patch: dict[str, Optional[str]]) -> Node:
+        """Strategic-merge patch of ``metadata.labels`` (None deletes)."""
+        self._call("patch_node")
+        with self._lock:
+            node = self._nodes.get_live(name)
+            if node is None:
+                raise NotFoundError(f"node {name}")
+            for k, v in patch.items():
+                if v is None:
+                    node.metadata.labels.pop(k, None)
+                else:
+                    node.metadata.labels[k] = v
+            self._nodes.put(name, node)
+            return deep_copy(node)
+
+    def patch_node_annotations(
+        self, name: str, patch: dict[str, Optional[str]]
+    ) -> Node:
+        """Merge patch of ``metadata.annotations`` (None deletes — the
+        reference's ``"null"`` convention, node_upgrade_state_provider.go:147)."""
+        self._call("patch_node")
+        with self._lock:
+            node = self._nodes.get_live(name)
+            if node is None:
+                raise NotFoundError(f"node {name}")
+            for k, v in patch.items():
+                if v is None:
+                    node.metadata.annotations.pop(k, None)
+                else:
+                    node.metadata.annotations[k] = v
+            self._nodes.put(name, node)
+            return deep_copy(node)
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        self._call("patch_node")
+        with self._lock:
+            node = self._nodes.get_live(name)
+            if node is None:
+                raise NotFoundError(f"node {name}")
+            node.spec.unschedulable = unschedulable
+            self._nodes.put(name, node)
+            return deep_copy(node)
+
+    def set_node_ready(self, name: str, ready: bool) -> Node:
+        self._call("patch_node")
+        with self._lock:
+            node = self._nodes.get_live(name)
+            if node is None:
+                raise NotFoundError(f"node {name}")
+            for cond in node.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = "True" if ready else "False"
+                    break
+            else:
+                node.status.conditions.append(
+                    NodeCondition("Ready", "True" if ready else "False")
+                )
+            self._nodes.put(name, node)
+            return deep_copy(node)
+
+    # -- pods --------------------------------------------------------------
+
+    @staticmethod
+    def _pod_key(namespace: str, name: str) -> tuple[str, str]:
+        return (namespace, name)
+
+    def create_pod(self, pod: Pod) -> Pod:
+        self._call("create_pod")
+        with self._lock:
+            key = self._pod_key(pod.namespace, pod.name)
+            if self._pods.get_live(key) is not None:
+                raise ConflictError(f"pod {key} exists")
+            self._pods.put(key, pod)
+            return deep_copy(pod)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        self._call("get_pod")
+        with self._lock:
+            obj = self._pods.get_live(self._pod_key(namespace, name))
+            if obj is None:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            return deep_copy(obj)
+
+    def list_pods(
+        self,
+        namespace: str = "",
+        label_selector: str = "",
+        node_name: Optional[str] = None,
+        match_labels: Optional[dict[str, str]] = None,
+    ) -> list[Pod]:
+        """List pods; ``namespace=""`` lists all namespaces, ``node_name``
+        models the ``spec.nodeName=`` field selector (consts.go:71-73)."""
+        self._call("list_pods")
+        with self._lock:
+            out = []
+            for pod in self._pods.objs.values():
+                if namespace and pod.namespace != namespace:
+                    continue
+                if node_name is not None and pod.spec.node_name != node_name:
+                    continue
+                if not matches_selector(pod.labels, label_selector):
+                    continue
+                if match_labels and not matches_labels(pod.labels, match_labels):
+                    continue
+                out.append(deep_copy(pod))
+            return out
+
+    def update_pod(self, pod: Pod) -> Pod:
+        """Replace pod object (tests use this to forge status, mirroring
+        envtest status updates — upgrade_suit_test.go:365-368)."""
+        self._call("update_pod")
+        with self._lock:
+            key = self._pod_key(pod.namespace, pod.name)
+            if self._pods.get_live(key) is None:
+                raise NotFoundError(f"pod {key}")
+            self._pods.put(key, pod)
+            return deep_copy(pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._call("delete_pod")
+        self._delete_pod_impl(namespace, name)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Eviction-API analogue (what drain actually calls)."""
+        self._call("evict_pod")
+        self._delete_pod_impl(namespace, name)
+
+    def _delete_pod_impl(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = self._pod_key(namespace, name)
+            pod = self._pods.get_live(key)
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            pod.metadata.deletion_timestamp = time.time()
+            self._pods.delete(key)
+            hooks = list(self._pod_deleted_hooks)
+        for hook in hooks:
+            hook(pod)
+
+    # -- daemonsets + controller revisions ----------------------------------
+
+    def create_daemon_set(self, ds: DaemonSet) -> DaemonSet:
+        self._call("create_daemon_set")
+        with self._lock:
+            key = (ds.namespace, ds.name)
+            if self._daemon_sets.get_live(key) is not None:
+                raise ConflictError(f"daemonset {key} exists")
+            self._daemon_sets.put(key, ds)
+            return deep_copy(ds)
+
+    def update_daemon_set(self, ds: DaemonSet) -> DaemonSet:
+        self._call("update_daemon_set")
+        with self._lock:
+            key = (ds.namespace, ds.name)
+            if self._daemon_sets.get_live(key) is None:
+                raise NotFoundError(f"daemonset {key}")
+            self._daemon_sets.put(key, ds)
+            return deep_copy(ds)
+
+    def get_daemon_set(self, namespace: str, name: str) -> DaemonSet:
+        self._call("get_daemon_set")
+        with self._lock:
+            obj = self._daemon_sets.get_live((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"daemonset {namespace}/{name}")
+            return deep_copy(obj)
+
+    def list_daemon_sets(
+        self, namespace: str = "", match_labels: Optional[dict[str, str]] = None
+    ) -> list[DaemonSet]:
+        self._call("list_daemon_sets")
+        with self._lock:
+            return [
+                deep_copy(ds)
+                for ds in self._daemon_sets.objs.values()
+                if (not namespace or ds.namespace == namespace)
+                and matches_labels(ds.metadata.labels, match_labels or {})
+            ]
+
+    def create_controller_revision(self, rev: ControllerRevision) -> ControllerRevision:
+        self._call("create_controller_revision")
+        with self._lock:
+            key = (rev.metadata.namespace, rev.metadata.name)
+            self._revisions.put(key, rev)
+            return deep_copy(rev)
+
+    def list_controller_revisions(
+        self, namespace: str = "", label_selector: str = ""
+    ) -> list[ControllerRevision]:
+        self._call("list_controller_revisions")
+        with self._lock:
+            return [
+                deep_copy(r)
+                for r in self._revisions.objs.values()
+                if (not namespace or r.metadata.namespace == namespace)
+                and matches_selector(r.metadata.labels, label_selector)
+            ]
+
+    # -- fixtures ----------------------------------------------------------
+
+    def add_daemon_set_revision(
+        self, ds: DaemonSet, hash_suffix: str, revision: int
+    ) -> ControllerRevision:
+        """Record a ControllerRevision ``<ds>-<hash>`` for a DaemonSet, the
+        way the real DS controller does on template change."""
+        rev = ControllerRevision(
+            metadata=ObjectMeta(
+                name=f"{ds.name}-{hash_suffix}",
+                namespace=ds.namespace,
+                labels=dict(ds.spec.selector.match_labels),
+            ),
+            revision=revision,
+        )
+        return self.create_controller_revision(rev)

@@ -1,0 +1,166 @@
+"""Drain helper: cordon/uncordon + filtered pod eviction.
+
+Behavioral analogue of ``k8s.io/kubectl/pkg/drain`` as the reference uses it
+(drain_manager.go:76-95, pod_manager.go:139-160, cordon_manager.go:39-48):
+
+- ``run_cordon_or_uncordon`` flips ``spec.unschedulable``;
+- ``get_pods_for_deletion`` applies kubectl's standard filters — skip
+  DaemonSet-owned pods when ``ignore_all_daemon_sets`` (the driver itself is
+  a DaemonSet pod, drain_manager.go:80-81), skip mirror pods, error on
+  emptyDir pods unless ``delete_empty_dir_data``, error on unreplicated
+  (orphaned) pods unless ``force`` — plus caller-supplied additional
+  filters (the PodManager's custom deletion filter, pod_manager.go:141-147);
+- ``delete_or_evict_pods`` evicts through the Eviction API and waits for
+  the pods to disappear, honoring the timeout.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from k8s_operator_libs_tpu.k8s.client import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s.objects import Node, Pod
+from k8s_operator_libs_tpu.k8s.selectors import matches_selector
+
+
+class DrainError(RuntimeError):
+    pass
+
+
+# An additional filter returns (delete: bool, skip_reason: str | None).
+PodFilter = Callable[[Pod], bool]
+
+
+@dataclass
+class PodDeleteList:
+    """Result of get_pods_for_deletion (drain.PodDeleteList analogue)."""
+
+    _pods: list[Pod] = field(default_factory=list)
+    _warnings: list[str] = field(default_factory=list)
+
+    def pods(self) -> list[Pod]:
+        return self._pods
+
+    def warnings(self) -> list[str]:
+        return self._warnings
+
+
+class DrainHelper:
+    """Drain configuration + operations (drain.Helper analogue)."""
+
+    def __init__(
+        self,
+        client: FakeCluster,
+        force: bool = False,
+        ignore_all_daemon_sets: bool = True,
+        delete_empty_dir_data: bool = False,
+        timeout_s: float = 0.0,  # 0 = infinite
+        pod_selector: str = "",
+        additional_filters: Optional[list[PodFilter]] = None,
+        on_pod_deleted: Optional[Callable[[Pod, bool], None]] = None,
+        poll_interval_s: float = 0.01,
+    ) -> None:
+        self.client = client
+        self.force = force
+        self.ignore_all_daemon_sets = ignore_all_daemon_sets
+        self.delete_empty_dir_data = delete_empty_dir_data
+        self.timeout_s = timeout_s
+        self.pod_selector = pod_selector
+        self.additional_filters = additional_filters or []
+        self.on_pod_deleted = on_pod_deleted
+        self.poll_interval_s = poll_interval_s
+
+    # -- cordon ------------------------------------------------------------
+
+    def run_cordon_or_uncordon(self, node: Node, desired: bool) -> None:
+        """Set node.spec.unschedulable = desired (idempotent)."""
+        self.client.set_node_unschedulable(node.name, desired)
+        node.spec.unschedulable = desired
+
+    # -- pod selection -----------------------------------------------------
+
+    def get_pods_for_deletion(
+        self, node_name: str
+    ) -> tuple[PodDeleteList, list[str]]:
+        """Apply kubectl-drain's filter chain to the node's pods.
+
+        Returns (deletable list incl. warnings, errors).  A pod failing a
+        fatal filter produces an error and is excluded, matching the
+        reference's "cannot delete all required pods" handling
+        (pod_manager.go:196-204).
+        """
+        pods = self.client.list_pods(
+            namespace="", label_selector=self.pod_selector, node_name=node_name
+        )
+        deletable: list[Pod] = []
+        warnings: list[str] = []
+        errors: list[str] = []
+        for pod in pods:
+            # Additional (caller) filters first: a skip here is silent,
+            # mirroring drain.MakePodDeleteStatusSkip (pod_manager.go:141-147).
+            if any(not f(pod) for f in self.additional_filters):
+                continue
+            if pod.is_mirror_pod():
+                continue
+            if pod.is_daemonset_pod():
+                if self.ignore_all_daemon_sets:
+                    warnings.append(f"ignoring DaemonSet-managed pod {pod.name}")
+                    continue
+                errors.append(f"cannot delete DaemonSet-managed pod {pod.name}")
+                continue
+            if pod.uses_empty_dir() and not self.delete_empty_dir_data:
+                errors.append(
+                    f"cannot delete pod {pod.name} with local storage (emptyDir)"
+                )
+                continue
+            if pod.is_orphaned() and not self.force:
+                errors.append(
+                    f"cannot delete pod {pod.name} not managed by a controller"
+                )
+                continue
+            deletable.append(pod)
+        return PodDeleteList(deletable, warnings), errors
+
+    # -- eviction ----------------------------------------------------------
+
+    def delete_or_evict_pods(self, pods: list[Pod]) -> None:
+        """Evict pods and wait until they are gone (or timeout)."""
+        deadline = (
+            time.monotonic() + self.timeout_s if self.timeout_s > 0 else None
+        )
+        for pod in pods:
+            try:
+                self.client.evict_pod(pod.namespace, pod.name)
+            except NotFoundError:
+                continue  # already gone
+            if self.on_pod_deleted is not None:
+                self.on_pod_deleted(pod, True)
+        # Wait for deletion to complete (kubectl waits for pods to vanish).
+        pending = {(p.namespace, p.name) for p in pods}
+        while pending:
+            gone = set()
+            for ns, name in pending:
+                try:
+                    self.client.get_pod(ns, name)
+                except NotFoundError:
+                    gone.add((ns, name))
+            pending -= gone
+            if not pending:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise DrainError(
+                    f"timed out waiting for pods to be deleted: {sorted(pending)}"
+                )
+            time.sleep(self.poll_interval_s)
+
+    def run_node_drain(self, node_name: str) -> None:
+        """Full drain: select pods, error if any fatal filter fired, evict.
+
+        Analogue of drain.RunNodeDrain (drain_manager.go:120).
+        """
+        delete_list, errors = self.get_pods_for_deletion(node_name)
+        if errors:
+            raise DrainError("; ".join(errors))
+        self.delete_or_evict_pods(delete_list.pods())

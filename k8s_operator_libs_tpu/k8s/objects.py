@@ -1,0 +1,215 @@
+"""Typed object model for the Kubernetes kinds the upgrade engine touches.
+
+The reference (Go) uses k8s.io/api types; the engine only ever reads/writes
+a narrow slice of them (SURVEY.md §3): Node labels/annotations/unschedulable/
+conditions, Pod phase/readiness/owner/revision-hash, DaemonSet selector +
+desired count, ControllerRevision name/revision.  This module models exactly
+that slice as plain dataclasses.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "uid") -> str:
+    return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclass
+class OwnerReference:
+    """Owner reference (only UID/kind/name are consulted by the engine)."""
+
+    name: str
+    uid: str
+    kind: str = "DaemonSet"
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str
+    namespace: str = ""
+    uid: str = field(default_factory=new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+    creation_timestamp: float = field(default_factory=time.time)
+    resource_version: int = 1
+
+
+@dataclass
+class NodeCondition:
+    type: str  # e.g. "Ready"
+    status: str  # "True" | "False" | "Unknown"
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeStatus:
+    conditions: list[NodeCondition] = field(
+        default_factory=lambda: [NodeCondition("Ready", "True")]
+    )
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return self.metadata.labels
+
+    @property
+    def annotations(self) -> dict[str, str]:
+        return self.metadata.annotations
+
+    def is_ready(self) -> bool:
+        """True unless a Ready condition exists with status != True
+        (reference upgrade_state.go:986-993)."""
+        for cond in self.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                return False
+        return True
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class ContainerStatus:
+    name: str = "main"
+    ready: bool = True
+    restart_count: int = 0
+
+
+@dataclass
+class Volume:
+    name: str = "vol"
+    empty_dir: bool = False
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    volumes: list[Volume] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: str = PodPhase.RUNNING
+    container_statuses: list[ContainerStatus] = field(
+        default_factory=lambda: [ContainerStatus()]
+    )
+    init_container_statuses: list[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return self.metadata.labels
+
+    def is_orphaned(self) -> bool:
+        """Pod with no owner references (reference upgrade_state.go:353-355)."""
+        return len(self.metadata.owner_references) == 0
+
+    def is_terminating(self) -> bool:
+        return self.metadata.deletion_timestamp is not None
+
+    def is_daemonset_pod(self) -> bool:
+        return any(o.kind == "DaemonSet" for o in self.metadata.owner_references)
+
+    def is_mirror_pod(self) -> bool:
+        return "kubernetes.io/config.mirror" in self.metadata.annotations
+
+    def uses_empty_dir(self) -> bool:
+        return any(v.empty_dir for v in self.spec.volumes)
+
+    def all_containers_ready(self) -> bool:
+        statuses = self.status.container_statuses
+        return len(statuses) > 0 and all(c.ready for c in statuses)
+
+
+@dataclass
+class LabelSelectorSpec:
+    match_labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodTemplateSpec:
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: LabelSelectorSpec = field(default_factory=LabelSelectorSpec)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ControllerRevision:
+    """History entry for a DaemonSet template; its name is
+    ``<ds-name>-<hash>`` and the newest ``revision`` wins
+    (reference pod_manager.go:94-121)."""
+
+    metadata: ObjectMeta
+    revision: int = 1
+
+
+def deep_copy(obj):
+    """DeepCopy analogue for any object in this model."""
+    return copy.deepcopy(obj)

@@ -1,0 +1,20 @@
+"""The TPU-native upgrade engine.
+
+Analogue of the reference's ``pkg/upgrade`` (see SURVEY.md §2.1): the
+cluster-wide, label-driven, idempotent upgrade state machine plus its six
+sub-managers — redesigned so the schedulable unit is an ICI slice (a group
+of hosts forming one TPU torus) instead of a single node.
+"""
+
+from k8s_operator_libs_tpu.upgrade.consts import (  # noqa: F401
+    STATE_ORDER,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.util import (  # noqa: F401
+    KeyedMutex,
+    StringSet,
+    UpgradeKeys,
+    default_keys,
+    get_upgrade_state_label_key,
+    set_driver_name,
+)

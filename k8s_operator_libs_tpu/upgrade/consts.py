@@ -1,0 +1,125 @@
+"""Upgrade states and node label / annotation key formats.
+
+Capability parity with the reference's ``pkg/upgrade/consts.go:19-78``:
+the same 11-state lattice (state values are identical strings so existing
+tooling/runbooks transfer), with the key namespace moved from
+``nvidia.com/<driver>-driver-upgrade-*`` to
+``tpu.google.com/<driver>-driver-upgrade-*`` and one genuinely new state
+dimension: slice-scoped keys for atomic multi-host TPU slice upgrades.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class UpgradeState(str, enum.Enum):
+    """The node/slice upgrade-state lattice.
+
+    Same semantics as reference ``pkg/upgrade/consts.go:42-67``.  The value
+    is stored in a node label and *is* the persistent state of the machine:
+    the library itself is stateless between reconcile passes.
+    """
+
+    # Node not processed yet / upgrade flow disabled (label absent).
+    UNKNOWN = ""
+    # Driver pod on the node is outdated; no actions performed yet.
+    UPGRADE_REQUIRED = "upgrade-required"
+    # Node must be made unschedulable before the driver upgrade.
+    CORDON_REQUIRED = "cordon-required"
+    # Wait (up to a timeout) for user jobs on the node to complete.
+    WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+    # Deletion of selected workload pods is required to proceed.
+    POD_DELETION_REQUIRED = "pod-deletion-required"
+    # Node must be scheduled for drain.
+    DRAIN_REQUIRED = "drain-required"
+    # Driver pod on the node is scheduled for restart, or safe-load unblock.
+    POD_RESTART_REQUIRED = "pod-restart-required"
+    # New driver on the node must be validated (TPU: slice health probe).
+    VALIDATION_REQUIRED = "validation-required"
+    # Driver pod is up-to-date and Ready; node must be made schedulable.
+    UNCORDON_REQUIRED = "uncordon-required"
+    # Upgrade finished; node schedulable and driver current.
+    DONE = "upgrade-done"
+    # Any failure during the upgrade lands here.
+    FAILED = "upgrade-failed"
+
+    def __str__(self) -> str:  # label value
+        return self.value
+
+
+# Forward progress order used to resolve the effective state of a slice whose
+# hosts momentarily disagree (e.g. after a crash mid-transition): the slice's
+# effective state is the EARLIEST state any member is in, so re-running the
+# pass re-drives every member forward idempotently.  FAILED dominates.
+STATE_ORDER: dict[UpgradeState, int] = {
+    UpgradeState.UNKNOWN: 0,
+    UpgradeState.DONE: 1,
+    UpgradeState.UPGRADE_REQUIRED: 2,
+    UpgradeState.CORDON_REQUIRED: 3,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED: 4,
+    UpgradeState.POD_DELETION_REQUIRED: 5,
+    UpgradeState.DRAIN_REQUIRED: 6,
+    UpgradeState.POD_RESTART_REQUIRED: 7,
+    UpgradeState.VALIDATION_REQUIRED: 8,
+    UpgradeState.UNCORDON_REQUIRED: 9,
+    UpgradeState.FAILED: 100,
+}
+
+# States counted as "upgrade in progress" (reference upgrade_state.go:1055-1062
+# counts everything except unknown/done/upgrade-required).
+IN_PROGRESS_STATES: tuple[UpgradeState, ...] = (
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.VALIDATION_REQUIRED,
+    UpgradeState.UNCORDON_REQUIRED,
+    UpgradeState.FAILED,
+)
+
+ALL_STATES: tuple[UpgradeState, ...] = tuple(UpgradeState)
+
+# --- key formats -----------------------------------------------------------
+# Reference: pkg/upgrade/consts.go:20-41 (nvidia.com/%s-driver-upgrade-*).
+# We parameterize the domain as well as the driver name; defaults target a
+# libtpu DaemonSet on GKE TPU node pools.
+KEY_DOMAIN_DEFAULT = "tpu.google.com"
+
+UPGRADE_STATE_LABEL_KEY_FMT = "{domain}/{driver}-driver-upgrade-state"
+UPGRADE_SKIP_NODE_LABEL_KEY_FMT = "{domain}/{driver}-driver-upgrade.skip"
+UPGRADE_WAIT_FOR_SAFE_DRIVER_LOAD_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade.driver-wait-for-safe-load"
+)
+UPGRADE_INITIAL_STATE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade.node-initial-state.unschedulable"
+)
+UPGRADE_WAIT_FOR_POD_COMPLETION_START_TIME_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-wait-for-pod-completion-start-time"
+)
+UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-validation-start-time"
+)
+UPGRADE_REQUESTED_ANNOTATION_KEY_FMT = "{domain}/{driver}-driver-upgrade-requested"
+
+# --- TPU-specific keys (new; no reference analogue) ------------------------
+# Slice identity label our topology layer writes/reads when GKE labels are
+# absent (on GKE, cloud.google.com/gke-nodepool + gke-tpu-topology are used).
+SLICE_ID_LABEL_KEY_FMT = "{domain}/{driver}-slice-id"
+# Multi-slice (DCN) group identity: slices in the same group serve one
+# data-parallel JobSet and must never be down simultaneously.
+DCN_GROUP_LABEL_KEY_FMT = "{domain}/{driver}-dcn-group"
+
+# GKE TPU node labels used for slice discovery (public GKE conventions).
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+# Field-selector format for listing pods on one node
+# (reference consts.go:71-73).
+NODE_NAME_FIELD_SELECTOR_FMT = "spec.nodeName={name}"
+
+NULL_STRING = "null"
+TRUE_STRING = "true"

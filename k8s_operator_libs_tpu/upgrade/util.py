@@ -1,0 +1,203 @@
+"""Concurrency primitives, key builders and event helpers.
+
+Capability parity with the reference's ``pkg/upgrade/util.go``:
+``StringSet`` (thread-safe set, util.go:26-66), ``KeyedMutex`` (per-key lock,
+util.go:69-85), the driver-name-parameterized label/annotation key builders
+(util.go:97-139) and event helpers (util.go:141-153).
+
+Per SURVEY.md §5 we avoid the reference's mutable package-global
+``DriverName`` as the primary API: keys live on an injectable
+:class:`UpgradeKeys` value object.  A module-level default instance plus
+:func:`set_driver_name` is kept for drop-in parity with the reference's
+``upgrade.SetDriverName`` call-shape.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field, replace
+
+from k8s_operator_libs_tpu.upgrade import consts as C
+
+
+class StringSet:
+    """Thread-safe set of strings (reference util.go:26-66).
+
+    Used by the drain/pod managers to deduplicate in-flight async work
+    across reconcile passes.
+    """
+
+    def __init__(self) -> None:
+        self._items: set[str] = set()
+        self._mu = threading.Lock()
+
+    def add(self, item: str) -> None:
+        with self._mu:
+            self._items.add(item)
+
+    def remove(self, item: str) -> None:
+        with self._mu:
+            self._items.discard(item)
+
+    def has(self, item: str) -> bool:
+        with self._mu:
+            return item in self._items
+
+    def clear(self) -> None:
+        with self._mu:
+            self._items.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)
+
+
+class KeyedMutex:
+    """Per-key mutual exclusion (reference util.go:69-85).
+
+    ``lock(key)`` returns a context manager so call sites read::
+
+        with mutex.lock(node_name):
+            ...
+    """
+
+    def __init__(self) -> None:
+        self._locks: dict[str, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    def lock(self, key: str) -> threading.Lock:
+        with self._guard:
+            lk = self._locks.get(key)
+            if lk is None:
+                lk = threading.Lock()
+                self._locks[key] = lk
+        return lk
+
+
+@dataclass(frozen=True)
+class UpgradeKeys:
+    """All label/annotation keys for one managed driver.
+
+    Analogue of reference util.go:97-139, but immutable and injectable
+    instead of reading a mutable package global.
+    """
+
+    driver_name: str = "libtpu"
+    domain: str = C.KEY_DOMAIN_DEFAULT
+
+    def _fmt(self, fmt: str) -> str:
+        return fmt.format(domain=self.domain, driver=self.driver_name)
+
+    @property
+    def state_label(self) -> str:
+        return self._fmt(C.UPGRADE_STATE_LABEL_KEY_FMT)
+
+    @property
+    def skip_label(self) -> str:
+        return self._fmt(C.UPGRADE_SKIP_NODE_LABEL_KEY_FMT)
+
+    @property
+    def safe_load_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_WAIT_FOR_SAFE_DRIVER_LOAD_ANNOTATION_KEY_FMT)
+
+    @property
+    def initial_state_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_INITIAL_STATE_ANNOTATION_KEY_FMT)
+
+    @property
+    def pod_completion_start_time_annotation(self) -> str:
+        return self._fmt(
+            C.UPGRADE_WAIT_FOR_POD_COMPLETION_START_TIME_ANNOTATION_KEY_FMT
+        )
+
+    @property
+    def validation_start_time_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT)
+
+    @property
+    def upgrade_requested_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_REQUESTED_ANNOTATION_KEY_FMT)
+
+    @property
+    def slice_id_label(self) -> str:
+        return self._fmt(C.SLICE_ID_LABEL_KEY_FMT)
+
+    @property
+    def dcn_group_label(self) -> str:
+        return self._fmt(C.DCN_GROUP_LABEL_KEY_FMT)
+
+    @property
+    def event_reason(self) -> str:
+        # Reference util.go:136-139: "<DRIVER>DriverUpgrade".
+        return f"{self.driver_name.upper()}DriverUpgrade"
+
+
+# Module-level default keys, mirroring the reference's SetDriverName +
+# GetUpgradeStateLabelKey call-shape for drop-in parity.
+default_keys = UpgradeKeys()
+
+
+def set_driver_name(driver: str) -> None:
+    """Set the driver name on the module-default :class:`UpgradeKeys`."""
+    global default_keys
+    default_keys = replace(default_keys, driver_name=driver)
+
+
+def get_upgrade_state_label_key() -> str:
+    return default_keys.state_label
+
+
+# --- events ---------------------------------------------------------------
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    """One recorded event (analogue of a corev1.Event)."""
+
+    object_name: str
+    event_type: str
+    reason: str
+    message: str
+
+
+class EventRecorder:
+    """Minimal event recorder interface.
+
+    Reference util.go:141-153 wraps client-go's ``record.EventRecorder``;
+    here the in-memory recorder is both the production default (events
+    surface through logs/metrics) and the test capture buffer (analogue of
+    ``record.NewFakeRecorder``, upgrade_suit_test.go:63).
+    """
+
+    def __init__(self, capacity: int = 1000) -> None:
+        self.events: list[Event] = []
+        self._capacity = capacity
+        self._mu = threading.Lock()
+
+    def eventf(
+        self, object_name: str, event_type: str, reason: str, message: str
+    ) -> None:
+        with self._mu:
+            if len(self.events) < self._capacity:
+                self.events.append(Event(object_name, event_type, reason, message))
+
+    def drain(self) -> list[Event]:
+        with self._mu:
+            out = self.events
+            self.events = []
+            return out
+
+
+def log_event(
+    recorder: EventRecorder | None,
+    object_name: str,
+    event_type: str,
+    reason: str,
+    message: str,
+) -> None:
+    """Record an event if a recorder is configured (util.go:141-153)."""
+    if recorder is not None:
+        recorder.eventf(object_name, event_type, reason, message)

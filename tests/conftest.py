@@ -1,0 +1,26 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests
+run on a virtual 8-device CPU backend (the TPU code paths are identical
+under jit — only the XLA target differs)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+import string
+
+import pytest
+
+
+@pytest.fixture
+def rand_suffix():
+    """Per-test random id for object-name isolation
+    (reference upgrade_suit_test.go:501-508)."""
+    return "".join(random.choices(string.ascii_lowercase, k=5))

@@ -1,0 +1,135 @@
+"""Policy-spec tests: defaults, validation, JSON round-trip, deep copy.
+
+Mirrors the reference's api/upgrade/v1alpha1 contract
+(upgrade_spec.go:27-110 defaults/validation markers, zz_generated deepcopy).
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    IntOrString,
+    PodDeletionSpec,
+    SliceHealthGateSpec,
+    SliceTopologySpec,
+    TPUUpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.api.v1alpha1 import ValidationError
+
+
+class TestIntOrString:
+    def test_int_passthrough(self):
+        assert IntOrString(5).scaled_value(100) == 5
+
+    def test_percent_rounds_up(self):
+        # 25% of 10 nodes -> 3 (reference rounds up, upgrade_state.go:396)
+        assert IntOrString("25%").scaled_value(10) == 3
+
+    def test_percent_round_down(self):
+        assert IntOrString("25%").scaled_value(10, round_up=False) == 2
+
+    def test_percent_exact(self):
+        assert IntOrString("25%").scaled_value(8) == 2
+
+    def test_invalid_string(self):
+        with pytest.raises(ValidationError):
+            IntOrString("banana")
+
+    def test_negative_int(self):
+        with pytest.raises(ValidationError):
+            IntOrString(-1)
+
+
+class TestDriverUpgradePolicySpec:
+    def test_defaults_match_reference(self):
+        # kubebuilder defaults: autoUpgrade=false, maxParallelUpgrades=1,
+        # maxUnavailable="25%" (upgrade_spec.go:31-45)
+        spec = DriverUpgradePolicySpec()
+        assert spec.auto_upgrade is False
+        assert spec.max_parallel_upgrades == 1
+        assert spec.max_unavailable.value == "25%"
+        assert spec.pod_deletion is None
+        assert spec.drain_spec is None
+
+    def test_nested_defaults(self):
+        assert PodDeletionSpec().timeout_second == 300
+        assert DrainSpec().timeout_second == 300
+        assert DrainSpec().enable is False
+        assert WaitForCompletionSpec().timeout_second == 0
+
+    def test_validation(self):
+        with pytest.raises(ValidationError):
+            DriverUpgradePolicySpec(max_parallel_upgrades=-1).validate()
+        with pytest.raises(ValidationError):
+            DriverUpgradePolicySpec(
+                drain_spec=DrainSpec(timeout_second=-5)
+            ).validate()
+
+    def test_json_round_trip_reference_shape(self):
+        # A policy YAML written for the reference loads unchanged
+        # (docs/automatic-ofed-upgrade.md:11-39 shape).
+        data = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 2,
+            "maxUnavailable": "30%",
+            "waitForCompletion": {"podSelector": "app=myapp", "timeoutSeconds": 300},
+            "podDeletion": {"force": True, "timeoutSeconds": 120},
+            "drain": {"enable": True, "force": False, "timeoutSeconds": 300},
+        }
+        spec = DriverUpgradePolicySpec.from_dict(data)
+        assert spec.auto_upgrade is True
+        assert spec.max_parallel_upgrades == 2
+        assert spec.max_unavailable.value == "30%"
+        assert spec.wait_for_completion.pod_selector == "app=myapp"
+        assert spec.pod_deletion.force is True
+        assert spec.drain_spec.enable is True
+        assert spec.drain_spec.timeout_second == 300
+        # round-trip
+        assert DriverUpgradePolicySpec.from_dict(spec.to_dict()) == spec
+
+    def test_deep_copy_is_independent(self):
+        spec = DriverUpgradePolicySpec(drain_spec=DrainSpec(enable=True))
+        cp = spec.deep_copy()
+        cp.drain_spec.enable = False
+        assert spec.drain_spec.enable is True
+
+    def test_unknown_fields_tolerated(self):
+        spec = DriverUpgradePolicySpec.from_dict({"autoUpgrade": True, "bogus": 1})
+        assert spec.auto_upgrade is True
+
+
+class TestTPUPolicy:
+    def test_defaults(self):
+        spec = TPUUpgradePolicySpec()
+        assert spec.slice_atomic is True
+        assert spec.unavailability_unit == "slice"
+        assert spec.health_gate.enable is True
+        assert spec.health_gate.min_reformation_fraction == 1.0
+        assert spec.dcn_anti_affinity is True
+
+    def test_topology_validation(self):
+        SliceTopologySpec(topology="2x2x4").validate()
+        assert SliceTopologySpec(topology="2x2x4").chips() == 16
+        assert SliceTopologySpec(topology="4x4").chips() == 16
+        with pytest.raises(ValidationError):
+            SliceTopologySpec(topology="2x").validate()
+
+    def test_unit_validation(self):
+        with pytest.raises(ValidationError):
+            TPUUpgradePolicySpec(unavailability_unit="pod").validate()
+
+    def test_health_gate_validation(self):
+        with pytest.raises(ValidationError):
+            SliceHealthGateSpec(min_reformation_fraction=1.5).validate()
+
+    def test_round_trip_with_tpu_fields(self):
+        spec = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            topology=SliceTopologySpec(accelerator="tpu-v5p-slice", topology="2x2x4"),
+            health_gate=SliceHealthGateSpec(dcn_check=True),
+        )
+        again = TPUUpgradePolicySpec.from_dict(spec.to_dict())
+        assert again == spec
+        assert again.topology.chips() == 16

@@ -1,0 +1,235 @@
+"""Tests for the K8s substrate: selectors, FakeCluster semantics, drain.
+
+This tier plays the role of the reference's envtest bootstrap checks: it
+pins the API semantics (patches, selectors, eviction, cache lag) that the
+upgrade engine depends on.
+"""
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    DrainError,
+    DrainHelper,
+    FakeCluster,
+    Node,
+    NotFoundError,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+)
+from k8s_operator_libs_tpu.k8s.objects import (
+    DaemonSetSpec,
+    LabelSelectorSpec,
+    PodSpec,
+    PodStatus,
+    Volume,
+)
+from k8s_operator_libs_tpu.k8s.selectors import (
+    matches_selector,
+    selector_from_match_labels,
+)
+
+
+class TestSelectors:
+    def test_equality(self):
+        assert matches_selector({"a": "b"}, "a=b")
+        assert matches_selector({"a": "b"}, "a==b")
+        assert not matches_selector({"a": "c"}, "a=b")
+
+    def test_inequality(self):
+        assert matches_selector({"a": "c"}, "a!=b")
+        assert not matches_selector({"a": "b"}, "a!=b")
+        assert matches_selector({}, "a!=b")  # absent key satisfies !=
+
+    def test_exists_and_not_exists(self):
+        assert matches_selector({"a": "x"}, "a")
+        assert not matches_selector({}, "a")
+        assert matches_selector({}, "!a")
+        assert not matches_selector({"a": "x"}, "!a")
+
+    def test_set_based(self):
+        assert matches_selector({"a": "x"}, "a in (x,y)")
+        assert not matches_selector({"a": "z"}, "a in (x,y)")
+        assert matches_selector({"a": "z"}, "a notin (x,y)")
+        assert matches_selector({}, "a notin (x,y)")
+
+    def test_conjunction(self):
+        assert matches_selector({"a": "x", "b": "y"}, "a=x,b=y")
+        assert not matches_selector({"a": "x"}, "a=x,b=y")
+        assert matches_selector({"a": "x", "b": "q"}, "a in (x,y),b=q")
+
+    def test_empty_matches_all(self):
+        assert matches_selector({}, "")
+        assert matches_selector({"a": "b"}, "  ")
+
+    def test_from_match_labels(self):
+        assert selector_from_match_labels({"b": "2", "a": "1"}) == "a=1,b=2"
+
+
+def mk_node(name, labels=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}))
+
+
+def mk_pod(name, node="", ns="default", labels=None, owner=None, phase=PodPhase.RUNNING):
+    meta = ObjectMeta(name=name, namespace=ns, labels=labels or {})
+    if owner is not None:
+        meta.owner_references = [owner]
+    return Pod(metadata=meta, spec=PodSpec(node_name=node),
+               status=PodStatus(phase=phase))
+
+
+class TestFakeCluster:
+    def test_node_crud_and_patch(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1", {"x": "1"}))
+        node = c.get_node("n1")
+        assert node.labels == {"x": "1"}
+        c.patch_node_labels("n1", {"y": "2"})
+        assert c.get_node("n1").labels == {"x": "1", "y": "2"}
+        c.patch_node_labels("n1", {"x": None})
+        assert c.get_node("n1").labels == {"y": "2"}
+
+    def test_annotation_merge_patch_null_delete(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        c.patch_node_annotations("n1", {"k": "v"})
+        assert c.get_node("n1").annotations["k"] == "v"
+        c.patch_node_annotations("n1", {"k": None})
+        assert "k" not in c.get_node("n1").annotations
+
+    def test_get_returns_copy(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        n = c.get_node("n1")
+        n.metadata.labels["mutated"] = "yes"
+        assert "mutated" not in c.get_node("n1").labels
+
+    def test_missing_node_raises(self):
+        c = FakeCluster()
+        with pytest.raises(NotFoundError):
+            c.get_node("nope")
+
+    def test_cache_lag_write_then_poll(self):
+        """The controller-runtime stale-cache problem the reference's
+        write-then-poll exists for (node_upgrade_state_provider.go:92-117):
+        a fresh write is NOT visible to cached reads until the lag passes."""
+        c = FakeCluster(cache_lag_s=0.15)
+        c.create_node(mk_node("n1"))
+        time.sleep(0.2)  # creation becomes visible
+        c.patch_node_labels("n1", {"s": "new"})
+        assert "s" not in c.get_node("n1", cached=True).labels  # stale
+        assert c.get_node("n1", cached=False).labels["s"] == "new"  # quorum
+        time.sleep(0.2)
+        assert c.get_node("n1", cached=True).labels["s"] == "new"  # synced
+
+    def test_pod_list_field_and_label_selectors(self):
+        c = FakeCluster()
+        c.create_pod(mk_pod("p1", node="n1", labels={"app": "driver"}))
+        c.create_pod(mk_pod("p2", node="n2", labels={"app": "driver"}))
+        c.create_pod(mk_pod("p3", node="n1", labels={"app": "other"}))
+        assert {p.name for p in c.list_pods(node_name="n1")} == {"p1", "p3"}
+        assert {p.name for p in c.list_pods(label_selector="app=driver")} == {
+            "p1",
+            "p2",
+        }
+        assert [p.name for p in c.list_pods(label_selector="app=driver",
+                                            node_name="n1")] == ["p1"]
+
+    def test_pod_delete_fires_hook(self):
+        c = FakeCluster()
+        seen = []
+        c.on_pod_deleted(lambda p: seen.append(p.name))
+        c.create_pod(mk_pod("p1"))
+        c.delete_pod("default", "p1")
+        assert seen == ["p1"]
+        with pytest.raises(NotFoundError):
+            c.get_pod("default", "p1")
+
+    def test_daemon_set_revisions(self):
+        c = FakeCluster()
+        ds = DaemonSet(
+            metadata=ObjectMeta(name="driver", namespace="d",
+                                labels={"app": "driver"}),
+            spec=DaemonSetSpec(selector=LabelSelectorSpec({"app": "driver"})),
+        )
+        c.create_daemon_set(ds)
+        c.add_daemon_set_revision(ds, "aaa", revision=1)
+        c.add_daemon_set_revision(ds, "bbb", revision=2)
+        revs = c.list_controller_revisions("d", "app=driver")
+        assert {r.metadata.name for r in revs} == {"driver-aaa", "driver-bbb"}
+
+    def test_stats_count_round_trips(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        c.get_node("n1")
+        c.get_node("n1")
+        assert c.stats["get_node"] == 2
+        assert c.stats["create_node"] == 1
+
+
+class TestDrainHelper:
+    def _cluster_with_workloads(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        owner = OwnerReference(name="rs", uid="rs-1", kind="ReplicaSet")
+        ds_owner = OwnerReference(name="driver", uid="ds-1", kind="DaemonSet")
+        c.create_pod(mk_pod("workload", node="n1", owner=owner))
+        c.create_pod(mk_pod("driver-pod", node="n1", owner=ds_owner))
+        return c
+
+    def test_cordon_uncordon(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        helper = DrainHelper(c)
+        node = c.get_node("n1")
+        helper.run_cordon_or_uncordon(node, True)
+        assert c.get_node("n1").spec.unschedulable
+        helper.run_cordon_or_uncordon(node, False)
+        assert not c.get_node("n1").spec.unschedulable
+
+    def test_daemonset_pods_ignored(self):
+        c = self._cluster_with_workloads()
+        helper = DrainHelper(c, ignore_all_daemon_sets=True)
+        dl, errors = helper.get_pods_for_deletion("n1")
+        assert errors == []
+        assert [p.name for p in dl.pods()] == ["workload"]
+        assert any("DaemonSet" in w for w in dl.warnings())
+
+    def test_orphaned_pod_requires_force(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        c.create_pod(mk_pod("orphan", node="n1"))
+        dl, errors = DrainHelper(c, force=False).get_pods_for_deletion("n1")
+        assert errors and not dl.pods()
+        dl, errors = DrainHelper(c, force=True).get_pods_for_deletion("n1")
+        assert not errors and [p.name for p in dl.pods()] == ["orphan"]
+
+    def test_empty_dir_requires_flag(self):
+        c = FakeCluster()
+        c.create_node(mk_node("n1"))
+        owner = OwnerReference(name="rs", uid="rs-1", kind="ReplicaSet")
+        pod = mk_pod("scratch", node="n1", owner=owner)
+        pod.spec.volumes = [Volume(name="tmp", empty_dir=True)]
+        c.create_pod(pod)
+        _, errors = DrainHelper(c, delete_empty_dir_data=False).get_pods_for_deletion("n1")
+        assert errors
+        dl, errors = DrainHelper(c, delete_empty_dir_data=True).get_pods_for_deletion("n1")
+        assert not errors and dl.pods()
+
+    def test_run_node_drain_evicts(self):
+        c = self._cluster_with_workloads()
+        DrainHelper(c).run_node_drain("n1")
+        names = {p.name for p in c.list_pods(node_name="n1")}
+        assert names == {"driver-pod"}  # DS pod survives, workload evicted
+
+    def test_custom_filter_skips(self):
+        c = self._cluster_with_workloads()
+        helper = DrainHelper(c, additional_filters=[lambda p: False])
+        dl, errors = helper.get_pods_for_deletion("n1")
+        assert not dl.pods() and not errors

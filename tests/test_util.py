@@ -1,0 +1,120 @@
+"""Tests for StringSet, KeyedMutex, UpgradeKeys and events
+(reference pkg/upgrade/util.go surface)."""
+
+import threading
+
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_NORMAL,
+    EventRecorder,
+    KeyedMutex,
+    StringSet,
+    UpgradeKeys,
+    default_keys,
+    get_upgrade_state_label_key,
+    log_event,
+    set_driver_name,
+)
+
+
+class TestStringSet:
+    def test_add_has_remove(self):
+        s = StringSet()
+        assert not s.has("a")
+        s.add("a")
+        assert s.has("a")
+        s.remove("a")
+        assert not s.has("a")
+
+    def test_clear(self):
+        s = StringSet()
+        s.add("a")
+        s.add("b")
+        s.clear()
+        assert len(s) == 0
+
+    def test_thread_safety(self):
+        s = StringSet()
+
+        def worker(i):
+            for j in range(200):
+                s.add(f"{i}-{j}")
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(s) == 1600
+
+
+class TestKeyedMutex:
+    def test_same_key_excludes(self):
+        m = KeyedMutex()
+        counter = {"v": 0}
+
+        def bump():
+            for _ in range(500):
+                with m.lock("k"):
+                    counter["v"] += 1
+
+        threads = [threading.Thread(target=bump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert counter["v"] == 2000
+
+    def test_different_keys_independent(self):
+        m = KeyedMutex()
+        lk_a = m.lock("a")
+        with lk_a:
+            # lock for a different key must be acquirable
+            assert m.lock("b").acquire(timeout=0.5)
+            m.lock("b").release()
+
+
+class TestUpgradeKeys:
+    def test_key_shapes(self):
+        keys = UpgradeKeys(driver_name="libtpu")
+        assert keys.state_label == "tpu.google.com/libtpu-driver-upgrade-state"
+        assert keys.skip_label == "tpu.google.com/libtpu-driver-upgrade.skip"
+        assert keys.safe_load_annotation == (
+            "tpu.google.com/libtpu-driver-upgrade.driver-wait-for-safe-load"
+        )
+        assert keys.upgrade_requested_annotation == (
+            "tpu.google.com/libtpu-driver-upgrade-requested"
+        )
+        assert keys.event_reason == "LIBTPUDriverUpgrade"
+
+    def test_module_default_parity_api(self):
+        # Reference call-shape: upgrade.SetDriverName("gpu") then key getters
+        # (util.go:93-100).
+        set_driver_name("tpu")
+        try:
+            assert get_upgrade_state_label_key() == (
+                "tpu.google.com/tpu-driver-upgrade-state"
+            )
+        finally:
+            set_driver_name("libtpu")
+
+    def test_keys_immutable(self):
+        keys = UpgradeKeys()
+        try:
+            keys.driver_name = "x"
+            raised = False
+        except AttributeError:
+            raised = True
+        assert raised
+
+
+class TestEvents:
+    def test_record_and_drain(self):
+        rec = EventRecorder()
+        log_event(rec, "node-1", EVENT_TYPE_NORMAL, "TPUDriverUpgrade", "hello")
+        assert len(rec.events) == 1
+        drained = rec.drain()
+        assert drained[0].message == "hello"
+        assert rec.events == []
+
+    def test_nil_recorder_is_noop(self):
+        log_event(None, "node-1", EVENT_TYPE_NORMAL, "r", "m")  # must not raise
