@@ -11,10 +11,41 @@ from k8s_operator_libs_tpu.upgrade.consts import (  # noqa: F401
     UpgradeState,
 )
 from k8s_operator_libs_tpu.upgrade.util import (  # noqa: F401
+    EventRecorder,
     KeyedMutex,
     StringSet,
     UpgradeKeys,
     default_keys,
     get_upgrade_state_label_key,
     set_driver_name,
+)
+from k8s_operator_libs_tpu.upgrade.types import (  # noqa: F401
+    ClusterUpgradeState,
+    NodeUpgradeState,
+    UpgradeGroup,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (  # noqa: F401
+    CacheSyncTimeout,
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.cordon_manager import CordonManager  # noqa: F401
+from k8s_operator_libs_tpu.upgrade.drain_manager import (  # noqa: F401
+    DrainConfiguration,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.pod_manager import (  # noqa: F401
+    PodManager,
+    PodManagerConfig,
+)
+from k8s_operator_libs_tpu.upgrade.validation_manager import (  # noqa: F401
+    PodValidationProber,
+    ProbeResult,
+    ValidationManager,
+)
+from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (  # noqa: F401
+    SafeDriverLoadManager,
+)
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (  # noqa: F401
+    BuildStateError,
+    ClusterUpgradeStateManager,
 )

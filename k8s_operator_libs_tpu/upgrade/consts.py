@@ -52,9 +52,12 @@ class UpgradeState(str, enum.Enum):
 # hosts momentarily disagree (e.g. after a crash mid-transition): the slice's
 # effective state is the EARLIEST state any member is in, so re-running the
 # pass re-drives every member forward idempotently.  FAILED dominates.
+# DONE sorts LAST among normal states: a group partially flipped to done
+# (one member stuck at uncordon-required after a crashed batch write) must
+# resolve to the straggler's state so the next pass re-drives it — ranking
+# done early would strand the straggler forever.
 STATE_ORDER: dict[UpgradeState, int] = {
     UpgradeState.UNKNOWN: 0,
-    UpgradeState.DONE: 1,
     UpgradeState.UPGRADE_REQUIRED: 2,
     UpgradeState.CORDON_REQUIRED: 3,
     UpgradeState.WAIT_FOR_JOBS_REQUIRED: 4,
@@ -63,8 +66,23 @@ STATE_ORDER: dict[UpgradeState, int] = {
     UpgradeState.POD_RESTART_REQUIRED: 7,
     UpgradeState.VALIDATION_REQUIRED: 8,
     UpgradeState.UNCORDON_REQUIRED: 9,
+    UpgradeState.DONE: 10,
     UpgradeState.FAILED: 100,
 }
+
+
+def parse_state(value: str) -> UpgradeState:
+    """Map a node label value to an UpgradeState.
+
+    The label is externally writable; an unrecognized value (typo, state
+    from a future version) must not crash the reconcile loop — it resolves
+    to UNKNOWN, which the done-or-unknown processor self-heals by
+    relabeling the node.
+    """
+    try:
+        return UpgradeState(value)
+    except ValueError:
+        return UpgradeState.UNKNOWN
 
 # States counted as "upgrade in progress" (reference upgrade_state.go:1055-1062
 # counts everything except unknown/done/upgrade-required).
@@ -111,11 +129,14 @@ SLICE_ID_LABEL_KEY_FMT = "{domain}/{driver}-slice-id"
 # data-parallel JobSet and must never be down simultaneously.
 DCN_GROUP_LABEL_KEY_FMT = "{domain}/{driver}-dcn-group"
 
-# GKE TPU node labels used for slice discovery (public GKE conventions).
-GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
-GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
-GKE_TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"
-GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# GKE TPU node labels (canonical definitions live in topology.slices,
+# which must not depend on this package; re-exported here for convenience).
+from k8s_operator_libs_tpu.topology.slices import (  # noqa: E402,F401
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    GKE_TPU_WORKER_ID_LABEL,
+)
 
 # Field-selector format for listing pods on one node
 # (reference consts.go:71-73).

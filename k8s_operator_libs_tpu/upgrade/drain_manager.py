@@ -1,0 +1,183 @@
+"""Async drain manager.
+
+Capability parity with the reference's ``DrainManager``
+(drain_manager.go:48-155): asynchronous drain workers deduplicated across
+reconcile passes by a :class:`StringSet`, cordon-then-drain, success moves
+the unit to ``pod-restart-required`` and failure to ``upgrade-failed`` —
+the "async actor + label mailbox" idiom (SURVEY.md §3.4).
+
+TPU redesign: the schedulable unit is an :class:`UpgradeGroup` (one ICI
+slice).  All hosts of a slice drain **concurrently inside one worker**, and
+the state transition happens once, at the group barrier — all-or-nothing:
+if any host fails to drain, the whole slice goes to ``upgrade-failed``
+(the torus would be split either way; a half-drained slice is not a
+usable TPU).  ``IgnoreAllDaemonSets`` stays true because the libtpu
+driver/device-plugin itself runs as a DaemonSet (reference
+drain_manager.go:80-81 has the same rationale for OFED pods).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+from k8s_operator_libs_tpu.k8s.objects import Node
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+    StringSet,
+    UpgradeKeys,
+    WorkerTracker,
+    log_event,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class DrainConfiguration:
+    """Drain spec + the groups to drain (reference DrainConfiguration,
+    drain_manager.go:32-36, lifted to groups)."""
+
+    spec: Optional[DrainSpec]
+    groups: list[UpgradeGroup] = field(default_factory=list)
+
+
+class DrainManager:
+    def __init__(
+        self,
+        client: FakeCluster,
+        node_state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        event_recorder: Optional[EventRecorder] = None,
+        max_hosts_concurrency: int = 32,
+    ) -> None:
+        self.client = client
+        self.provider = node_state_provider
+        self.keys = keys
+        self.event_recorder = event_recorder
+        self.max_hosts_concurrency = max_hosts_concurrency
+        # Dedup of in-flight drains across reconcile passes
+        # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
+        self._draining = StringSet()
+        self._tracker = WorkerTracker()
+
+    def schedule_groups_drain(self, config: DrainConfiguration) -> None:
+        """Schedule async drain for each group not already draining."""
+        if not config.groups:
+            logger.info("Drain Manager: no groups scheduled to drain")
+            return
+        if config.spec is None:
+            raise ValueError("drain spec should not be empty")
+        if not config.spec.enable:
+            logger.info("Drain Manager: drain is disabled")
+            return
+
+        for group in config.groups:
+            if self._draining.has(group.id):
+                logger.info("group %s already draining, skipping", group.id)
+                continue
+            self._draining.add(group.id)
+            for node in group.nodes:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_NORMAL,
+                    self.keys.event_reason,
+                    "Scheduling drain of the node",
+                )
+            self._tracker.spawn(
+                lambda g=group, s=config.spec: self._drain_group(g, s),
+                name=f"drain-{group.id}",
+            )
+
+    # Reference-parity shim: drain a list of nodes as singleton groups.
+    def schedule_nodes_drain(
+        self, spec: Optional[DrainSpec], nodes: Sequence[Node]
+    ) -> None:
+        groups = [
+            UpgradeGroup(id=n.name, members=[NodeUpgradeState(node=n)])
+            for n in nodes
+        ]
+        self.schedule_groups_drain(DrainConfiguration(spec=spec, groups=groups))
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Join outstanding drain workers (test/bench convenience; the
+        reference relies on Eventually-style polling instead)."""
+        return self._tracker.wait_idle(timeout_s)
+
+    # -- worker -------------------------------------------------------------
+
+    def _drain_group(self, group: UpgradeGroup, spec: DrainSpec) -> None:
+        try:
+            helper = DrainHelper(
+                self.client,
+                force=spec.force,
+                ignore_all_daemon_sets=True,
+                delete_empty_dir_data=spec.delete_empty_dir,
+                timeout_s=float(spec.timeout_second),
+                pod_selector=spec.pod_selector,
+            )
+            failed: list[str] = []
+            # Phase 1: cordon every host first (no half-schedulable slice),
+            # then drain hosts concurrently.
+            for node in group.nodes:
+                try:
+                    helper.run_cordon_or_uncordon(node, True)
+                except Exception as e:  # noqa: BLE001
+                    logger.error("failed to cordon %s: %s", node.name, e)
+                    failed.append(node.name)
+            if not failed:
+                with ThreadPoolExecutor(
+                    max_workers=min(self.max_hosts_concurrency, group.size())
+                ) as pool:
+                    futures = {
+                        pool.submit(helper.run_node_drain, node.name): node
+                        for node in group.nodes
+                    }
+                    for fut, node in futures.items():
+                        try:
+                            fut.result()
+                        except Exception as e:  # noqa: BLE001
+                            logger.error("failed to drain %s: %s", node.name, e)
+                            log_event(
+                                self.event_recorder,
+                                node.name,
+                                EVENT_TYPE_WARNING,
+                                self.keys.event_reason,
+                                f"Failed to drain the node, {e}",
+                            )
+                            failed.append(node.name)
+
+            # Group barrier: all-or-nothing transition.
+            if failed:
+                self._set_group_state(group, UpgradeState.FAILED)
+            else:
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_NORMAL,
+                        self.keys.event_reason,
+                        "Successfully drained the node",
+                    )
+                self._set_group_state(group, UpgradeState.POD_RESTART_REQUIRED)
+        finally:
+            self._draining.remove(group.id)
+
+    def _set_group_state(self, group: UpgradeGroup, state: UpgradeState) -> None:
+        try:
+            self.provider.change_nodes_upgrade_state(group.nodes, state)
+        except Exception as e:  # noqa: BLE001 — async actor: next pass re-drives
+            logger.error("failed to set group %s state %s: %s", group.id, state, e)

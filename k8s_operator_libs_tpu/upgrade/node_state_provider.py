@@ -1,0 +1,221 @@
+"""Synchronized node mutation with read-your-writes guarantees.
+
+Capability parity with the reference's ``NodeUpgradeStateProvider``
+(node_upgrade_state_provider.go:33-216): per-node keyed mutex, label patch
+for the upgrade state, merge-patch for annotations with the ``"null"``
+delete convention, then **poll the (possibly stale) read cache until the
+write is visible** — the trick that makes the stateless reconcile loop safe
+when the controller cache lags the apiserver
+(node_upgrade_state_provider.go:92-99).
+
+TPU redesign on top of parity: **batched group transitions**.  The
+reference pays (patch + up-to-10s poll) serially per node; on a 16-host
+v5p-64 slice that alone eats the <2 min downtime budget (SURVEY.md §7
+'hard parts').  ``change_nodes_upgrade_state`` issues all patches
+concurrently and then polls all nodes concurrently, so a whole slice's
+label flip costs one round-trip + one cache-sync wait, not N.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s.objects import Node
+from k8s_operator_libs_tpu.upgrade.consts import NULL_STRING, UpgradeState
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+    KeyedMutex,
+    UpgradeKeys,
+    log_event,
+    run_batch,
+)
+
+logger = get_logger(__name__)
+
+
+class CacheSyncTimeout(RuntimeError):
+    """The written value never became visible in the read cache."""
+
+
+class NodeUpgradeStateProvider:
+    """Synchronized node label/annotation writes with cache-sync waits."""
+
+    def __init__(
+        self,
+        client: FakeCluster,
+        keys: UpgradeKeys,
+        event_recorder: Optional[EventRecorder] = None,
+        poll_interval_s: float = 1.0,
+        poll_timeout_s: float = 10.0,
+        max_concurrency: int = 32,
+    ) -> None:
+        # Reference defaults: 1 s poll, 10 s timeout
+        # (node_upgrade_state_provider.go:100-103).
+        self.client = client
+        self.keys = keys
+        self.event_recorder = event_recorder
+        self.poll_interval_s = poll_interval_s
+        self.poll_timeout_s = poll_timeout_s
+        self.max_concurrency = max_concurrency
+        self._node_mutex = KeyedMutex()
+
+    # -- reads -------------------------------------------------------------
+
+    def get_node(self, node_name: str) -> Node:
+        with self._node_mutex.lock(node_name):
+            return self.client.get_node(node_name)
+
+    # -- single-node writes (reference parity) ------------------------------
+
+    def change_node_upgrade_state(self, node: Node, new_state: UpgradeState) -> None:
+        """Patch the state label and wait until the cache shows it."""
+        with self._node_mutex.lock(node.name):
+            self._patch_state(node.name, new_state)
+            self._wait_label_visible(node, self.keys.state_label, new_state.value)
+
+    def change_node_upgrade_annotation(
+        self, node: Node, key: str, value: str
+    ) -> None:
+        """Patch an annotation; ``value == "null"`` deletes it
+        (node_upgrade_state_provider.go:147-150)."""
+        with self._node_mutex.lock(node.name):
+            patch_value = None if value == NULL_STRING else value
+            self.client.patch_node_annotations(node.name, {key: patch_value})
+            self._wait_annotation_visible(node, key, value)
+
+    # -- batched group writes (TPU-native fast path) -------------------------
+
+    def change_nodes_upgrade_state(
+        self, nodes: Sequence[Node], new_state: UpgradeState
+    ) -> None:
+        """Atomically-intended batch: flip the state label on every node of
+        a slice, concurrently, then wait for all writes to be visible.
+
+        Raises on the first failure after all attempts complete, so a
+        partially-written slice is re-driven by the next idempotent pass
+        (the group's effective_state resolves to the earliest member)."""
+        run_batch(
+            [
+                (lambda n=n: self.change_node_upgrade_state(n, new_state))
+                for n in nodes
+            ],
+            self.max_concurrency,
+        )
+
+    def change_nodes_upgrade_annotation(
+        self, nodes: Sequence[Node], key: str, value: str
+    ) -> None:
+        run_batch(
+            [
+                (lambda n=n: self.change_node_upgrade_annotation(n, key, value))
+                for n in nodes
+            ],
+            self.max_concurrency,
+        )
+
+    # -- internals ----------------------------------------------------------
+
+    def _patch_state(self, node_name: str, new_state: UpgradeState) -> None:
+        # UNKNOWN means "label absent": a strategic-merge delete.
+        value = new_state.value if new_state != UpgradeState.UNKNOWN else None
+        try:
+            self.client.patch_node_labels(node_name, {self.keys.state_label: value})
+        except Exception:
+            log_event(
+                self.event_recorder,
+                node_name,
+                EVENT_TYPE_WARNING,
+                self.keys.event_reason,
+                f"Failed to update node state label to {new_state.value}",
+            )
+            raise
+
+    def _wait_label_visible(
+        self, node: Node, label_key: str, expected: str
+    ) -> None:
+        deadline = time.monotonic() + self.poll_timeout_s
+        while True:
+            try:
+                fresh = self.client.get_node(node.name, cached=True)
+            except NotFoundError:
+                # Object not yet visible in the read cache — keep polling,
+                # that is exactly the situation this loop exists for.
+                fresh = None
+            actual = fresh.labels.get(label_key, "") if fresh else None
+            if fresh is not None and actual == expected:
+                # Refresh caller's node object (the reference mutates the
+                # passed *corev1.Node via Get into it).
+                node.metadata = fresh.metadata
+                node.spec = fresh.spec
+                node.status = fresh.status
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_NORMAL,
+                    self.keys.event_reason,
+                    f"Successfully updated node state label to {expected}",
+                )
+                return
+            if time.monotonic() >= deadline:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    f"Failed to update node state label to {expected}: "
+                    "cache sync timeout",
+                )
+                raise CacheSyncTimeout(
+                    f"node {node.name}: label {label_key}={expected!r} not "
+                    f"visible within {self.poll_timeout_s}s (saw {actual!r})"
+                )
+            time.sleep(min(self.poll_interval_s, max(0.0, deadline - time.monotonic())))
+
+    def _wait_annotation_visible(self, node: Node, key: str, value: str) -> None:
+        deadline = time.monotonic() + self.poll_timeout_s
+        while True:
+            try:
+                fresh = self.client.get_node(node.name, cached=True)
+            except NotFoundError:
+                fresh = None
+            if fresh is None:
+                ok = False
+                actual = None
+            else:
+                actual = fresh.annotations.get(key)
+                ok = (
+                    (actual is None)
+                    if value == NULL_STRING
+                    else (actual == value)
+                )
+            if ok:
+                node.metadata = fresh.metadata
+                node.spec = fresh.spec
+                node.status = fresh.status
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_NORMAL,
+                    self.keys.event_reason,
+                    f"Successfully updated node annotation {key}={value}",
+                )
+                return
+            if time.monotonic() >= deadline:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    f"Failed to update node annotation {key}={value}: "
+                    "cache sync timeout",
+                )
+                raise CacheSyncTimeout(
+                    f"node {node.name}: annotation {key}={value!r} not visible "
+                    f"within {self.poll_timeout_s}s"
+                )
+            time.sleep(min(self.poll_interval_s, max(0.0, deadline - time.monotonic())))

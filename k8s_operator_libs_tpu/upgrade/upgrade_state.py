@@ -1,0 +1,823 @@
+"""The cluster upgrade state machine — slice-aware.
+
+Capability parity with the reference's ``ClusterUpgradeStateManager``
+(upgrade_state.go:55-1121): ``build_state`` snapshots the cluster
+(DaemonSets → owned pods → nodes grouped by upgrade-state label),
+``apply_state`` runs one stateless, idempotent pass that moves every unit
+at most one state forward under ``maxParallelUpgrades``/``maxUnavailable``,
+with the same nine per-state processors and the same slot math
+(upgrade_state.go:1074-1102).
+
+TPU redesign (SURVEY.md §7 step 2): the schedulable unit is an
+:class:`UpgradeGroup` — every host of one ICI slice — which moves through
+cordon → wait-for-jobs → pod-deletion → drain → pod-restart → validation →
+uncordon **atomically**, because interrupting any host interrupts the
+collective for the whole torus.  Non-TPU nodes form singleton groups, which
+makes the group machinery degenerate to exactly the reference's per-node
+semantics.  Slot accounting can run at slice or node granularity
+(``TPUUpgradePolicySpec.unavailability_unit``), and a slice with any
+cordoned/not-ready host counts as one unavailable slice — the torus is
+down either way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (
+    DriverUpgradePolicySpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
+from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
+from k8s_operator_libs_tpu.upgrade.consts import (
+    IN_PROGRESS_STATES,
+    TRUE_STRING,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.cordon_manager import CordonManager
+from k8s_operator_libs_tpu.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.pod_manager import (
+    PodDeletionFilter,
+    PodManager,
+    PodManagerConfig,
+)
+from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (
+    SafeDriverLoadManager,
+)
+from k8s_operator_libs_tpu.upgrade.types import (
+    ClusterUpgradeState,
+    NodeUpgradeState,
+    UpgradeGroup,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder, UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.validation_manager import (
+    PodValidationProber,
+    SliceProber,
+    ValidationManager,
+)
+
+logger = get_logger(__name__)
+
+# Container restart count beyond which a not-ready driver pod is declared
+# failing (upgrade_state.go:966-978).
+DRIVER_POD_FAILING_RESTART_THRESHOLD = 10
+
+
+class BuildStateError(RuntimeError):
+    pass
+
+
+class ClusterUpgradeStateManager:
+    """State machine driver (reference upgrade_state.go:102-186)."""
+
+    def __init__(
+        self,
+        client: FakeCluster,
+        keys: Optional[UpgradeKeys] = None,
+        event_recorder: Optional[EventRecorder] = None,
+        node_state_provider: Optional[NodeUpgradeStateProvider] = None,
+        cordon_manager: Optional[CordonManager] = None,
+        drain_manager: Optional[DrainManager] = None,
+        pod_manager: Optional[PodManager] = None,
+        validation_manager: Optional[ValidationManager] = None,
+        safe_driver_load_manager: Optional[SafeDriverLoadManager] = None,
+        poll_interval_s: float = 1.0,
+        poll_timeout_s: float = 10.0,
+    ) -> None:
+        self.client = client
+        self.keys = keys or UpgradeKeys()
+        self.event_recorder = event_recorder
+        self.provider = node_state_provider or NodeUpgradeStateProvider(
+            client,
+            self.keys,
+            event_recorder,
+            poll_interval_s=poll_interval_s,
+            poll_timeout_s=poll_timeout_s,
+        )
+        self.cordon_manager = cordon_manager or CordonManager(client)
+        self.drain_manager = drain_manager or DrainManager(
+            client, self.provider, self.keys, event_recorder
+        )
+        self.pod_manager = pod_manager or PodManager(
+            client, self.provider, self.keys, None, event_recorder
+        )
+        self.validation_manager = validation_manager or ValidationManager(
+            client, self.provider, self.keys, None, event_recorder
+        )
+        self.safe_driver_load_manager = (
+            safe_driver_load_manager
+            or SafeDriverLoadManager(self.provider, self.keys)
+        )
+        self._pod_deletion_enabled = False
+        self._validation_enabled = False
+
+    # -- option builders (upgrade_state.go:153-186) --------------------------
+
+    def with_pod_deletion_enabled(
+        self, pod_deletion_filter: PodDeletionFilter
+    ) -> "ClusterUpgradeStateManager":
+        if pod_deletion_filter is None:
+            logger.warning(
+                "cannot enable PodDeletion state: filter is None"
+            )
+            return self
+        self.pod_manager.pod_deletion_filter = pod_deletion_filter
+        self._pod_deletion_enabled = True
+        return self
+
+    def with_validation_enabled(
+        self, pod_selector_or_prober
+    ) -> "ClusterUpgradeStateManager":
+        """Enable the validation state with either a pod selector string
+        (reference parity) or a SliceProber (TPU health gate)."""
+        if not pod_selector_or_prober:
+            logger.warning("cannot enable Validation state: empty selector")
+            return self
+        prober: SliceProber
+        if isinstance(pod_selector_or_prober, str):
+            prober = PodValidationProber(self.client, pod_selector_or_prober)
+        else:
+            prober = pod_selector_or_prober
+        self.validation_manager.prober = prober
+        self._validation_enabled = True
+        return self
+
+    def is_pod_deletion_enabled(self) -> bool:
+        return self._pod_deletion_enabled
+
+    def is_validation_enabled(self) -> bool:
+        return self._validation_enabled
+
+    # -- BuildState (upgrade_state.go:214-279) -------------------------------
+
+    def build_state(
+        self,
+        namespace: str,
+        driver_labels: dict[str, str],
+        policy: Optional[DriverUpgradePolicySpec] = None,
+    ) -> ClusterUpgradeState:
+        """Point-in-time snapshot: DaemonSets → owned pods → nodes, grouped
+        by upgrade-state label and (new) by ICI slice.
+
+        ``policy`` is optional (reference signature parity); pass it to
+        honor ``TPUUpgradePolicySpec.slice_atomic=False`` (every node a
+        singleton group) and ``topology.hosts_per_slice`` overrides."""
+        logger.info("building state")
+        daemon_sets = {
+            ds.metadata.uid: ds
+            for ds in self.client.list_daemon_sets(namespace, driver_labels)
+        }
+        pods = self.client.list_pods(
+            namespace=namespace, match_labels=driver_labels
+        )
+
+        filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
+        for ds in daemon_sets.values():
+            ds_pods = [
+                p
+                for p in pods
+                if not p.is_orphaned()
+                and p.metadata.owner_references[0].uid == ds.metadata.uid
+            ]
+            if ds.status.desired_number_scheduled != len(ds_pods):
+                # Guard (upgrade_state.go:243-246): a partially-scheduled
+                # driver DaemonSet gives an incoherent snapshot.
+                raise BuildStateError(
+                    "driver DaemonSet should not have Unscheduled pods"
+                )
+            filtered.extend((p, ds) for p in ds_pods)
+        filtered.extend((p, None) for p in pods if p.is_orphaned())
+
+        state = ClusterUpgradeState()
+        node_states_by_name: dict[str, NodeUpgradeState] = {}
+        for pod, ds in filtered:
+            if not pod.spec.node_name:
+                logger.info("driver pod %s has no node, skipping", pod.name)
+                continue
+            node = self.provider.get_node(pod.spec.node_name)
+            nus = NodeUpgradeState(node=node, driver_pod=pod, driver_daemon_set=ds)
+            node_states_by_name[node.name] = nus
+            label_state = node.labels.get(self.keys.state_label, "")
+            state.node_states.setdefault(label_state, []).append(nus)
+
+        self._build_groups(state, node_states_by_name, policy)
+        return state
+
+    def _build_groups(
+        self,
+        state: ClusterUpgradeState,
+        node_states_by_name: dict[str, NodeUpgradeState],
+        policy: Optional[DriverUpgradePolicySpec] = None,
+    ) -> None:
+        """Bundle node states into slice groups; non-TPU nodes become
+        singletons (degenerating to reference per-node semantics)."""
+        slice_atomic = True
+        hosts_override = 0
+        if isinstance(policy, TPUUpgradePolicySpec):
+            slice_atomic = policy.slice_atomic
+            if policy.topology is not None:
+                hosts_override = policy.topology.hosts_per_slice
+        slice_members: dict[str, list[NodeUpgradeState]] = {}
+        slice_infos: dict[str, object] = {}
+        singletons: list[NodeUpgradeState] = []
+        for nus in node_states_by_name.values():
+            info = slice_info_for_node(nus.node, self.keys)
+            if info is None or not slice_atomic:
+                singletons.append(nus)
+            else:
+                if hosts_override > 0:
+                    info.expected_hosts = hosts_override
+                slice_members.setdefault(info.slice_id, []).append(nus)
+                slice_infos.setdefault(info.slice_id, info)
+        groups: list[UpgradeGroup] = []
+        for slice_id, members in sorted(slice_members.items()):
+            members.sort(key=lambda m: m.node.name)
+            groups.append(
+                UpgradeGroup(
+                    id=slice_id,
+                    members=members,
+                    slice_info=slice_infos[slice_id],  # type: ignore[arg-type]
+                )
+            )
+        groups.extend(
+            UpgradeGroup(id=nus.node.name, members=[nus]) for nus in singletons
+        )
+        for group in groups:
+            eff = group.effective_state(self.keys.state_label)
+            state.groups.setdefault(eff.value, []).append(group)
+
+    # -- ApplyState (upgrade_state.go:364-484) -------------------------------
+
+    def apply_state(
+        self,
+        current_state: Optional[ClusterUpgradeState],
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """One stateless, idempotent pass over the snapshot."""
+        if current_state is None:
+            raise ValueError("currentState should not be empty")
+        if policy is None or not policy.auto_upgrade:
+            logger.info("driver auto upgrade is disabled, skipping")
+            return
+
+        logger.info(
+            "state counts: %s",
+            {s.value or "unknown": len(current_state.nodes_in(s)) for s in UpgradeState},
+        )
+
+        # TPU health-gate knobs: validation timeout + gate disable.
+        validation_active = self.is_validation_enabled()
+        if isinstance(policy, TPUUpgradePolicySpec) and policy.health_gate is not None:
+            if policy.health_gate.timeout_second:
+                self.validation_manager.timeout_seconds = (
+                    policy.health_gate.timeout_second
+                )
+            if not policy.health_gate.enable:
+                validation_active = False
+
+        unit = self._unavailability_unit(policy)
+        total_units = self._total_units(current_state, unit)
+        max_unavailable = total_units
+        if policy.max_unavailable is not None:
+            max_unavailable = policy.max_unavailable.scaled_value(
+                total_units, round_up=True
+            )
+        upgrades_available = self.get_upgrades_available_units(
+            current_state, policy.max_parallel_upgrades, max_unavailable, unit
+        )
+        logger.info(
+            "upgrades in progress: %d, available slots: %d (unit=%s, "
+            "maxUnavailable=%d, total=%d)",
+            self._in_progress_units(current_state, unit),
+            upgrades_available,
+            unit,
+            max_unavailable,
+            total_units,
+        )
+
+        self.process_done_or_unknown_groups(current_state, UpgradeState.UNKNOWN)
+        self.process_done_or_unknown_groups(current_state, UpgradeState.DONE)
+        self.process_upgrade_required_groups(
+            current_state, upgrades_available, unit, policy
+        )
+        self.process_cordon_required_groups(current_state)
+        self.process_wait_for_jobs_required_groups(
+            current_state, policy.wait_for_completion
+        )
+        drain_enabled = policy.drain_spec is not None and policy.drain_spec.enable
+        self.process_pod_deletion_required_groups(
+            current_state, policy.pod_deletion, drain_enabled
+        )
+        self.process_drain_groups(current_state, policy.drain_spec)
+        self.process_pod_restart_groups(current_state, validation_active)
+        self.process_upgrade_failed_groups(current_state)
+        self.process_validation_required_groups(current_state, validation_active)
+        self.process_uncordon_required_groups(current_state)
+        logger.info("state manager finished processing")
+
+    # -- processors ----------------------------------------------------------
+
+    def process_done_or_unknown_groups(
+        self, state: ClusterUpgradeState, state_name: UpgradeState
+    ) -> None:
+        """Decide upgrade-required vs done (upgrade_state.go:488-550).
+        A slice requires upgrade if ANY host needs it — it moves whole."""
+        for group in state.groups_in(state_name):
+            requires = False
+            for member in group.members:
+                synced, orphaned = self._pod_in_sync_with_ds(member)
+                if (not synced and not orphaned) or self._is_upgrade_requested(
+                    member.node
+                ):
+                    requires = True
+            if self.safe_driver_load_manager.is_group_waiting_for_safe_driver_load(
+                group
+            ):
+                logger.info(
+                    "group %s is waiting for safe driver load, "
+                    "initializing upgrade",
+                    group.id,
+                )
+                requires = True
+            if requires:
+                # Track hosts that were already unschedulable so uncordon is
+                # skipped for them at the end (upgrade_state.go:510-523).
+                already_cordoned = [
+                    m.node for m in group.members if m.node.spec.unschedulable
+                ]
+                if already_cordoned:
+                    self.provider.change_nodes_upgrade_annotation(
+                        already_cordoned,
+                        self.keys.initial_state_annotation,
+                        TRUE_STRING,
+                    )
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.UPGRADE_REQUIRED
+                )
+                logger.info("group %s requires upgrade", group.id)
+                continue
+            if state_name == UpgradeState.UNKNOWN:
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.DONE
+                )
+                logger.info("group %s -> upgrade-done", group.id)
+
+    def _in_flight_dcn_groups(self, state: ClusterUpgradeState) -> set[str]:
+        """DCN (multi-slice) groups that currently have a slice in flight or
+        unavailable.  Under dcn_anti_affinity, no second slice of the same
+        group may start — taking both down would stall the whole
+        data-parallel JobSet (BASELINE config 5)."""
+        in_flight: set[str] = set()
+        for group in state.all_groups():
+            if group.slice_info is None or group.slice_info.dcn_group is None:
+                continue
+            eff = group.effective_state(self.keys.state_label)
+            if eff in IN_PROGRESS_STATES or self._group_unavailable(group):
+                in_flight.add(group.slice_info.dcn_group)
+        return in_flight
+
+    def process_upgrade_required_groups(
+        self,
+        state: ClusterUpgradeState,
+        upgrades_available: int,
+        unit: str,
+        policy: Optional[DriverUpgradePolicySpec] = None,
+    ) -> None:
+        """Consume slots and move groups to cordon-required
+        (upgrade_state.go:587-631), plus the TPU guards: never start an
+        incomplete slice (a torus with missing hosts would be split by the
+        upgrade itself) and never take two slices of one DCN group down
+        simultaneously when dcn_anti_affinity is set."""
+        dcn_anti_affinity = (
+            isinstance(policy, TPUUpgradePolicySpec) and policy.dcn_anti_affinity
+        )
+        busy_dcn = self._in_flight_dcn_groups(state) if dcn_anti_affinity else set()
+        for group in state.groups_in(UpgradeState.UPGRADE_REQUIRED):
+            requested = [
+                m.node
+                for m in group.members
+                if self._is_upgrade_requested(m.node)
+            ]
+            if requested:
+                # Clear the externally-set upgrade-requested annotation.
+                self.provider.change_nodes_upgrade_annotation(
+                    requested, self.keys.upgrade_requested_annotation, "null"
+                )
+            if any(
+                m.node.labels.get(self.keys.skip_label) == TRUE_STRING
+                for m in group.members
+            ):
+                logger.info("group %s is marked to skip upgrades", group.id)
+                continue
+            if (
+                group.slice_info is not None
+                and group.size() < group.slice_info.expected_hosts
+            ):
+                logger.warning(
+                    "slice %s has %d/%d hosts visible; refusing to start an "
+                    "upgrade on an incomplete slice",
+                    group.id,
+                    group.size(),
+                    group.slice_info.expected_hosts,
+                )
+                continue
+            if (
+                dcn_anti_affinity
+                and group.slice_info is not None
+                and group.slice_info.dcn_group is not None
+                and group.slice_info.dcn_group in busy_dcn
+            ):
+                logger.info(
+                    "slice %s deferred: another slice of DCN group %s is in "
+                    "flight (dcn_anti_affinity)",
+                    group.id,
+                    group.slice_info.dcn_group,
+                )
+                continue
+            cost = 1 if unit == "slice" else group.size()
+            if upgrades_available < cost:
+                # Already-cordoned groups bypass the slot limit
+                # (upgrade_state.go:606-616).
+                if all(m.node.spec.unschedulable for m in group.members):
+                    logger.info(
+                        "group %s already cordoned, progressing", group.id
+                    )
+                else:
+                    logger.info(
+                        "upgrade limit reached, pausing group %s", group.id
+                    )
+                    continue
+            else:
+                upgrades_available -= cost
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.CORDON_REQUIRED
+            )
+            if (
+                group.slice_info is not None
+                and group.slice_info.dcn_group is not None
+            ):
+                busy_dcn.add(group.slice_info.dcn_group)
+            logger.info("group %s waiting for cordon", group.id)
+
+    def process_cordon_required_groups(self, state: ClusterUpgradeState) -> None:
+        """Cordon all hosts, then advance (upgrade_state.go:635-654)."""
+        for group in state.groups_in(UpgradeState.CORDON_REQUIRED):
+            self.cordon_manager.cordon_nodes(group.nodes)
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.WAIT_FOR_JOBS_REQUIRED
+            )
+
+    def process_wait_for_jobs_required_groups(
+        self, state: ClusterUpgradeState, wait_spec
+    ) -> None:
+        """(upgrade_state.go:658-693)"""
+        groups = state.groups_in(UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        if not groups:
+            return
+        if wait_spec is None or not wait_spec.pod_selector:
+            next_state = (
+                UpgradeState.POD_DELETION_REQUIRED
+                if self.is_pod_deletion_enabled()
+                else UpgradeState.DRAIN_REQUIRED
+            )
+            for group in groups:
+                self.provider.change_nodes_upgrade_state(group.nodes, next_state)
+            return
+        self.pod_manager.schedule_check_on_pod_completion(
+            PodManagerConfig(groups=groups, wait_for_completion_spec=wait_spec)
+        )
+
+    def process_pod_deletion_required_groups(
+        self, state: ClusterUpgradeState, deletion_spec, drain_enabled: bool
+    ) -> None:
+        """(upgrade_state.go:698-727)"""
+        groups = state.groups_in(UpgradeState.POD_DELETION_REQUIRED)
+        if not groups:
+            return
+        if not self.is_pod_deletion_enabled():
+            for group in groups:
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.DRAIN_REQUIRED
+                )
+            return
+        self.pod_manager.schedule_pod_eviction(
+            PodManagerConfig(
+                groups=groups,
+                deletion_spec=deletion_spec,
+                drain_enabled=drain_enabled,
+            )
+        )
+
+    def process_drain_groups(self, state: ClusterUpgradeState, drain_spec) -> None:
+        """(upgrade_state.go:731-760)"""
+        groups = state.groups_in(UpgradeState.DRAIN_REQUIRED)
+        if not groups:
+            return
+        if drain_spec is None or not drain_spec.enable:
+            logger.info("node drain is disabled by policy, skipping")
+            for group in groups:
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.POD_RESTART_REQUIRED
+                )
+            return
+        self.drain_manager.schedule_groups_drain(
+            DrainConfiguration(spec=drain_spec, groups=groups)
+        )
+
+    def process_pod_restart_groups(
+        self, state: ClusterUpgradeState, validation_active: Optional[bool] = None
+    ) -> None:
+        """Restart outdated driver pods; advance fully-recovered groups
+        (upgrade_state.go:764-831)."""
+        if validation_active is None:
+            validation_active = self.is_validation_enabled()
+        for group in state.groups_in(UpgradeState.POD_RESTART_REQUIRED):
+            pods_to_restart: list[Pod] = []
+            synced_members: list[NodeUpgradeState] = []
+            for member in group.members:
+                synced, orphaned = self._pod_in_sync_with_ds(member)
+                if not synced or orphaned:
+                    # Only restart pods not already terminating
+                    # (upgrade_state.go:775-781).
+                    if (
+                        member.driver_pod is not None
+                        and not member.driver_pod.is_terminating()
+                    ):
+                        pods_to_restart.append(member.driver_pod)
+                else:
+                    synced_members.append(member)
+            if pods_to_restart:
+                self.pod_manager.schedule_pods_restart(pods_to_restart)
+            # A synced-but-crash-looping new driver fails the whole slice
+            # (upgrade_state.go:811-825 lifted to the group).
+            failing = [
+                m
+                for m in synced_members
+                if m.driver_pod is not None
+                and self._is_driver_pod_failing(m.driver_pod)
+            ]
+            if failing:
+                logger.info(
+                    "driver pod(s) failing with repeated restarts in group %s",
+                    group.id,
+                )
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.FAILED
+                )
+                continue
+            if len(synced_members) != group.size():
+                continue  # restarts pending; next pass re-checks
+            # Every pod carries the new template: the slice is quiesced, so
+            # release any held driver loads in one batch (safe-load protocol,
+            # upgrade_state.go:783).
+            self.safe_driver_load_manager.unblock_group_loading(group)
+            if all(self._is_driver_pod_in_sync(m) for m in group.members):
+                if validation_active:
+                    self.provider.change_nodes_upgrade_state(
+                        group.nodes, UpgradeState.VALIDATION_REQUIRED
+                    )
+                else:
+                    self._update_group_to_uncordon_or_done(group)
+
+    def process_upgrade_failed_groups(self, state: ClusterUpgradeState) -> None:
+        """Auto-recover failed groups whose driver pods are all back in sync
+        (upgrade_state.go:835-877)."""
+        for group in state.groups_in(UpgradeState.FAILED):
+            if all(self._is_driver_pod_in_sync(m) for m in group.members):
+                self._update_group_to_uncordon_or_done(group)
+
+    def process_validation_required_groups(
+        self, state: ClusterUpgradeState, validation_active: Optional[bool] = None
+    ) -> None:
+        """(upgrade_state.go:880-911)"""
+        if validation_active is None:
+            validation_active = self.is_validation_enabled()
+        for group in state.groups_in(UpgradeState.VALIDATION_REQUIRED):
+            # Driver may have restarted after reaching validation: make sure
+            # it isn't re-blocked on safe load (upgrade_state.go:886-893).
+            self.safe_driver_load_manager.unblock_group_loading(group)
+            if validation_active and not self.validation_manager.validate(group):
+                logger.info("validation not complete for group %s", group.id)
+                continue
+            self._update_group_to_uncordon_or_done(group)
+
+    def process_uncordon_required_groups(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Uncordon and finish (upgrade_state.go:915-934).  Hosts that were
+        unschedulable before the upgrade stay cordoned
+        (upgrade_state.go:1003-1028)."""
+        for group in state.groups_in(UpgradeState.UNCORDON_REQUIRED):
+            keep_cordoned_key = self.keys.initial_state_annotation
+            to_uncordon = [
+                m.node
+                for m in group.members
+                if keep_cordoned_key not in m.node.annotations
+            ]
+            annotated = [
+                m.node
+                for m in group.members
+                if keep_cordoned_key in m.node.annotations
+            ]
+            self.cordon_manager.uncordon_nodes(to_uncordon)
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.DONE
+            )
+            if annotated:
+                self.provider.change_nodes_upgrade_annotation(
+                    annotated, keep_cordoned_key, "null"
+                )
+
+    # -- shared helpers ------------------------------------------------------
+
+    def _update_group_to_uncordon_or_done(self, group: UpgradeGroup) -> None:
+        """Skip uncordon for groups whose every host started cordoned
+        (upgrade_state.go:1000-1028); mixed groups go through uncordon,
+        where per-host skip applies."""
+        key = self.keys.initial_state_annotation
+        if all(key in m.node.annotations for m in group.members):
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.DONE
+            )
+            self.provider.change_nodes_upgrade_annotation(
+                group.nodes, key, "null"
+            )
+        else:
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.UNCORDON_REQUIRED
+            )
+
+    def _pod_in_sync_with_ds(
+        self, member: NodeUpgradeState
+    ) -> tuple[bool, bool]:
+        """(synced, orphaned) via revision hashes (upgrade_state.go:552-578)."""
+        if member.is_orphaned_pod():
+            return False, True
+        pod_hash = self.pod_manager.get_pod_controller_revision_hash(
+            member.driver_pod
+        )
+        ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
+            member.driver_daemon_set
+        )
+        return pod_hash == ds_hash, False
+
+    def _is_driver_pod_in_sync(self, member: NodeUpgradeState) -> bool:
+        """Synced + Running + all containers ready (upgrade_state.go:936-964)."""
+        synced, orphaned = self._pod_in_sync_with_ds(member)
+        if orphaned or not synced:
+            return False
+        pod = member.driver_pod
+        return (
+            pod is not None
+            and pod.status.phase == "Running"
+            and pod.all_containers_ready()
+        )
+
+    def _is_driver_pod_failing(self, pod: Pod) -> bool:
+        """Repeated container restarts (upgrade_state.go:966-978)."""
+        for status in list(pod.status.init_container_statuses) + list(
+            pod.status.container_statuses
+        ):
+            if not status.ready and status.restart_count > (
+                DRIVER_POD_FAILING_RESTART_THRESHOLD
+            ):
+                return True
+        return False
+
+    def _is_upgrade_requested(self, node: Node) -> bool:
+        return (
+            node.annotations.get(self.keys.upgrade_requested_annotation)
+            == TRUE_STRING
+        )
+
+    @staticmethod
+    def _unavailability_unit(policy: DriverUpgradePolicySpec) -> str:
+        if isinstance(policy, TPUUpgradePolicySpec):
+            return policy.unavailability_unit
+        return "node"
+
+    # -- counters (upgrade_state.go:1034-1120 + group variants) --------------
+
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        return sum(len(v) for v in state.node_states.values())
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        return sum(
+            len(state.nodes_in(s)) for s in IN_PROGRESS_STATES
+        )
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.DONE))
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.FAILED))
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED))
+
+    def get_total_managed_groups(self, state: ClusterUpgradeState) -> int:
+        return len(state.all_groups())
+
+    def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
+        """Cordoned or not-ready nodes (upgrade_state.go:192-211)."""
+        count = 0
+        for states in state.node_states.values():
+            for nus in states:
+                if nus.node.spec.unschedulable or not nus.node.is_ready():
+                    count += 1
+        return count
+
+    def _group_unavailable(self, group: UpgradeGroup) -> bool:
+        """A slice with any cordoned/not-ready host is an unavailable slice."""
+        return any(
+            m.node.spec.unschedulable or not m.node.is_ready()
+            for m in group.members
+        )
+
+    def _total_units(self, state: ClusterUpgradeState, unit: str) -> int:
+        if unit == "slice":
+            return self.get_total_managed_groups(state)
+        return self.get_total_managed_nodes(state)
+
+    def _in_progress_units(self, state: ClusterUpgradeState, unit: str) -> int:
+        if unit == "slice":
+            return sum(
+                len(state.groups_in(s)) for s in IN_PROGRESS_STATES
+            )
+        return self.get_upgrades_in_progress(state)
+
+    def _unavailable_units(self, state: ClusterUpgradeState, unit: str) -> int:
+        if unit == "slice":
+            return sum(
+                1 for g in state.all_groups() if self._group_unavailable(g)
+            )
+        return self.get_current_unavailable_nodes(state)
+
+    def get_upgrades_available_units(
+        self,
+        state: ClusterUpgradeState,
+        max_parallel_upgrades: int,
+        max_unavailable: int,
+        unit: str = "node",
+    ) -> int:
+        """Slot math (upgrade_state.go:1074-1102), at node or slice
+        granularity."""
+        in_progress = self._in_progress_units(state, unit)
+        total = self._total_units(state, unit)
+
+        if max_parallel_upgrades == 0:
+            # Unlimited: everything pending may start.
+            if unit == "slice":
+                available = len(state.groups_in(UpgradeState.UPGRADE_REQUIRED))
+            else:
+                available = len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED))
+        else:
+            available = max_parallel_upgrades - in_progress
+
+        # Units already unavailable plus those about to be cordoned.
+        if unit == "slice":
+            current_unavailable = self._unavailable_units(state, unit) + len(
+                state.groups_in(UpgradeState.CORDON_REQUIRED)
+            )
+        else:
+            current_unavailable = self._unavailable_units(state, unit) + len(
+                state.nodes_in(UpgradeState.CORDON_REQUIRED)
+            )
+
+        available = min(available, max_unavailable)
+        if current_unavailable >= max_unavailable:
+            available = 0
+        elif (
+            max_unavailable < total
+            and current_unavailable + available > max_unavailable
+        ):
+            available = max_unavailable - current_unavailable
+        return max(0, available)
+
+    # Reference-parity alias for the node-granular signature
+    # (upgrade_state.go:1074).
+    def get_upgrades_available(
+        self,
+        state: ClusterUpgradeState,
+        max_parallel_upgrades: int,
+        max_unavailable: int,
+    ) -> int:
+        return self.get_upgrades_available_units(
+            state, max_parallel_upgrades, max_unavailable, "node"
+        )
+
+    # -- test/bench convenience ---------------------------------------------
+
+    def wait_for_async_work(self, timeout_s: float = 30.0) -> bool:
+        """Join outstanding drain/eviction workers."""
+        ok = self.drain_manager.wait_idle(timeout_s)
+        return self.pod_manager.wait_idle(timeout_s) and ok

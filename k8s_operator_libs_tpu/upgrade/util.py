@@ -15,7 +15,10 @@ Per SURVEY.md §5 we avoid the reference's mutable package-global
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field, replace
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, replace
+from typing import Callable
 
 from k8s_operator_libs_tpu.upgrade import consts as C
 
@@ -145,6 +148,62 @@ def set_driver_name(driver: str) -> None:
 
 def get_upgrade_state_label_key() -> str:
     return default_keys.state_label
+
+
+# --- shared concurrency helpers --------------------------------------------
+
+
+def run_batch(tasks: list[Callable[[], None]], max_workers: int = 32) -> None:
+    """Run callables concurrently; after all complete, raise the first error.
+
+    The batch fan-out used for slice-wide operations (state-label flips,
+    cordons, pod restarts): everything is attempted even if one member
+    fails, so a partially-written slice is maximally advanced and the next
+    idempotent pass re-drives the stragglers.
+    """
+    tasks = list(tasks)
+    if not tasks:
+        return
+    if len(tasks) == 1:
+        tasks[0]()
+        return
+    errors: list[Exception] = []
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(tasks))) as pool:
+        futures = [pool.submit(t) for t in tasks]
+        for fut in futures:
+            try:
+                fut.result()
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+    if errors:
+        raise errors[0]
+
+
+class WorkerTracker:
+    """Tracks async actor threads (drain/eviction workers) so tests and
+    bench can join them; the deadline applies to the whole set."""
+
+    def __init__(self) -> None:
+        self._workers: list[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def spawn(self, target: Callable[[], None], name: str) -> None:
+        worker = threading.Thread(target=target, name=name, daemon=True)
+        with self._lock:
+            self._workers.append(worker)
+        worker.start()
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            workers = list(self._workers)
+        ok = True
+        for w in workers:
+            w.join(max(0.0, deadline - time.monotonic()))
+            ok = ok and not w.is_alive()
+        with self._lock:
+            self._workers = [w for w in self._workers if w.is_alive()]
+        return ok
 
 
 # --- events ---------------------------------------------------------------

@@ -1,0 +1,156 @@
+"""Validation manager: post-upgrade health gate.
+
+Capability parity with the reference's ``ValidationManager``
+(validation_manager.go:35-175): after the driver restarts, hold the unit in
+``validation-required`` until validation succeeds, with a start-time
+annotation and a timeout that fails the upgrade
+(validation_manager.go:139-175, 600 s default).
+
+TPU redesign: validation is a pluggable **slice health prober**.  The
+reference can only check that a validation pod is Ready (the actual
+nvidia-smi check lives in out-of-repo consumer operators, SURVEY.md §2.3);
+here the prober interface is first-class and ships with:
+
+- :class:`PodValidationProber` — reference-parity: pods matching
+  ``pod_selector`` on every host of the group are Running+Ready;
+- ``health.JaxSliceProber`` (see k8s_operator_libs_tpu/health) — the real
+  TPU gate: device re-enumeration + MXU matmul + ICI all-reduce across the
+  slice, "validated" = 100 % slice re-formation + collective completes.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.objects import Pod, PodPhase
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+    UpgradeKeys,
+    log_event,
+)
+
+logger = get_logger(__name__)
+
+# Reference validation_manager.go:31-33.
+VALIDATION_TIMEOUT_SECONDS_DEFAULT = 600
+
+
+@dataclass
+class ProbeResult:
+    healthy: bool
+    detail: str = ""
+
+
+class SliceProber(Protocol):
+    """Anything that can render a health verdict for an upgrade group."""
+
+    def probe(self, group: UpgradeGroup) -> ProbeResult: ...
+
+
+class PodValidationProber:
+    """Reference-parity prober: validation pods Ready on every host
+    (validation_manager.go:71-136)."""
+
+    def __init__(self, client: FakeCluster, pod_selector: str) -> None:
+        self.client = client
+        self.pod_selector = pod_selector
+
+    def _is_pod_ready(self, pod: Pod) -> bool:
+        return (
+            pod.status.phase == PodPhase.RUNNING and pod.all_containers_ready()
+        )
+
+    def probe(self, group: UpgradeGroup) -> ProbeResult:
+        if not self.pod_selector:
+            return ProbeResult(True, "no pod selector; validation disabled")
+        for node in group.nodes:
+            pods = self.client.list_pods(
+                label_selector=self.pod_selector, node_name=node.name
+            )
+            if not pods:
+                return ProbeResult(
+                    False, f"no validation pods found on node {node.name}"
+                )
+            for pod in pods:
+                if not self._is_pod_ready(pod):
+                    return ProbeResult(
+                        False,
+                        f"validation pod {pod.name} on {node.name} not ready",
+                    )
+        return ProbeResult(True, "all validation pods ready")
+
+
+class ValidationManager:
+    def __init__(
+        self,
+        client: FakeCluster,
+        node_state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        prober: Optional[SliceProber] = None,
+        event_recorder: Optional[EventRecorder] = None,
+        timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS_DEFAULT,
+    ) -> None:
+        self.client = client
+        self.provider = node_state_provider
+        self.keys = keys
+        self.prober = prober
+        self.event_recorder = event_recorder
+        self.timeout_seconds = timeout_seconds
+
+    def validate(self, group: UpgradeGroup) -> bool:
+        """Probe the group; on failure run the timeout clock
+        (validation_manager.go:94-115 lifted to groups).  Returns True when
+        validation passed and the group may advance."""
+        if self.prober is None:
+            return True
+        result = self.prober.probe(group)
+        if not result.healthy:
+            logger.info("group %s validation pending: %s", group.id, result.detail)
+            self._handle_timeout(group)
+            return False
+        # Passed: clear the start-time annotation.
+        self.provider.change_nodes_upgrade_annotation(
+            [
+                n
+                for n in group.nodes
+                if self.keys.validation_start_time_annotation in n.annotations
+            ],
+            self.keys.validation_start_time_annotation,
+            "null",
+        )
+        return True
+
+    def _handle_timeout(self, group: UpgradeGroup) -> None:
+        key = self.keys.validation_start_time_annotation
+        now = int(time.time())
+        unstamped = [n for n in group.nodes if key not in n.annotations]
+        if unstamped:
+            self.provider.change_nodes_upgrade_annotation(unstamped, key, str(now))
+        stamped = [n for n in group.nodes if key in n.annotations]
+        if len(stamped) != group.size():
+            return
+        start = min(int(n.annotations[key]) for n in stamped)
+        if self.timeout_seconds and now > start + self.timeout_seconds:
+            logger.info("group %s validation timed out -> failed", group.id)
+            for node in group.nodes:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    "Validation timed out for the driver upgrade",
+                )
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.FAILED
+            )
+            self.provider.change_nodes_upgrade_annotation(group.nodes, key, "null")

@@ -1,0 +1,146 @@
+"""End-to-end rolling upgrade: the minimum end-to-end slice of SURVEY.md §7.
+
+A fake multi-slice TPU pool (2× 4-host v5p slices + 1 plain node) with a
+libtpu DaemonSet whose template is bumped; the reconcile loop (build_state +
+apply_state) is ticked until every node reaches upgrade-done.  Asserts:
+
+- every driver pod ends on the new revision hash, nodes schedulable;
+- **slice atomicity**: between passes, all hosts of one slice always share
+  the same upgrade state and the same cordon status (the torus is never
+  split);
+- **maxParallelUpgrades=1 (slice unit)**: at most one slice is in flight
+  at any observation point.
+"""
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    PodDeletionSpec,
+    TPUUpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import IN_PROGRESS_STATES
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+from tests.test_upgrade_state import FakeProber
+
+KEYS = UpgradeKeys()
+
+
+def test_full_rolling_upgrade_two_slices():
+    c = FakeCluster()
+    fx = ClusterFixture(c)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    slice_a = fx.tpu_slice("pool-a", hosts=4)
+    slice_b = fx.tpu_slice("pool-b", hosts=4)
+    plain = fx.node()
+    all_nodes = slice_a + slice_b + [plain]
+    for n in all_nodes:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        fx.workload_pod(n, labels={"app": "train"})
+
+    # Roll the template: h1 -> h2; DS controller recreates pods with h2.
+    fx.bump_daemon_set_template(ds, "h2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "h2")
+
+    prober = FakeProber(healthy=True)
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    mgr.with_pod_deletion_enabled(lambda p: p.labels.get("app") == "train")
+    mgr.with_validation_enabled(prober)
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString("34%"),
+        unavailability_unit="slice",
+        pod_deletion=PodDeletionSpec(timeout_second=5),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        wait_for_completion=WaitForCompletionSpec(),
+    )
+
+    slice_names = {"pool-a": [n.name for n in slice_a],
+                   "pool-b": [n.name for n in slice_b]}
+
+    def check_invariants():
+        in_flight_slices = set()
+        for sid, names in slice_names.items():
+            nodes = [c.get_node(nm) for nm in names]
+            states = {n.labels.get(KEYS.state_label, "") for n in nodes}
+            # Atomicity: all hosts of a slice share one state.
+            assert len(states) == 1, f"slice {sid} split across states {states}"
+            cordons = {n.spec.unschedulable for n in nodes}
+            assert len(cordons) == 1, f"slice {sid} partially cordoned"
+            state = states.pop()
+            if state and UpgradeState(state) in IN_PROGRESS_STATES:
+                in_flight_slices.add(sid)
+        assert len(in_flight_slices) <= 1, (
+            f"maxParallelUpgrades=1 violated: {in_flight_slices}"
+        )
+
+    for tick in range(60):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        assert mgr.wait_for_async_work()
+        check_invariants()
+        done = all(
+            c.get_node(n.name).labels.get(KEYS.state_label)
+            == UpgradeState.DONE.value
+            for n in all_nodes
+        )
+        if done:
+            break
+    else:
+        raise AssertionError("upgrade did not converge in 60 ticks")
+
+    # Every driver pod runs the new template; every node is schedulable.
+    for n in all_nodes:
+        pods = [
+            p
+            for p in c.list_pods(node_name=n.name)
+            if p.labels.get("app") == DRIVER_LABELS["app"]
+        ]
+        assert len(pods) == 1
+        assert pods[0].labels["controller-revision-hash"] == "h2"
+        assert not c.get_node(n.name).spec.unschedulable
+    assert prober.calls >= 3  # each slice + plain node validated
+
+
+def test_rolling_upgrade_converges_with_node_unit_policy():
+    """Node-granular accounting still drives slices atomically."""
+    c = FakeCluster()
+    fx = ClusterFixture(c)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    fx.bump_daemon_set_template(ds, "h2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "h2")
+
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("100%"),
+        unavailability_unit="node",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    for _ in range(40):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+        assert mgr.wait_for_async_work()
+        if all(
+            c.get_node(n.name).labels.get(KEYS.state_label)
+            == UpgradeState.DONE.value
+            for n in nodes
+        ):
+            break
+    else:
+        raise AssertionError("upgrade did not converge")

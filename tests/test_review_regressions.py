@@ -1,0 +1,236 @@
+"""Regression tests for defects found in code review: import order, cache
+lag resilience, partial-batch recovery, bad labels, and the TPU policy
+fields (dcn anti-affinity, incomplete-slice guard, health gate knobs,
+slice_atomic=False)."""
+
+import subprocess
+import sys
+import time
+
+from k8s_operator_libs_tpu.api import (
+    IntOrString,
+    SliceHealthGateSpec,
+    SliceTopologySpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    NodeUpgradeStateProvider,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import parse_state
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+from tests.test_upgrade_state import FakeProber, auto_policy, make_manager
+
+KEYS = UpgradeKeys()
+
+
+def test_topology_package_importable_first():
+    """Importing topology before upgrade must not hit a circular import."""
+    code = (
+        "import k8s_operator_libs_tpu.topology; "
+        "import k8s_operator_libs_tpu.upgrade; "
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+def test_state_change_polls_through_cache_lag():
+    """The write-then-poll loop must survive NotFound from a cold cache
+    (node created moments before the write)."""
+    c = FakeCluster(cache_lag_s=0.15)
+    fx = ClusterFixture(c, KEYS)
+    n = fx.node()
+    provider = NodeUpgradeStateProvider(
+        c, KEYS, poll_interval_s=0.02, poll_timeout_s=3.0
+    )
+    # Immediately write: cached reads will raise NotFound at first.
+    provider.change_node_upgrade_state(n, UpgradeState.UPGRADE_REQUIRED)
+    assert (
+        c.get_node(n.name, cached=False).labels[KEYS.state_label]
+        == UpgradeState.UPGRADE_REQUIRED.value
+    )
+
+
+def test_partially_done_group_is_redriven():
+    """A slice crashed mid-flip to done (one member stuck at
+    uncordon-required) must resolve to uncordon-required and be re-driven,
+    not stranded in the done bucket."""
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    n0 = fx.tpu_node("pool-a", 0, state=UpgradeState.DONE)
+    n1 = fx.tpu_node(
+        "pool-a", 1, state=UpgradeState.UNCORDON_REQUIRED, unschedulable=True
+    )
+    for n in (n0, n1):
+        fx.driver_pod(n, None)
+    mgr = make_manager(c)
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    assert len(state.groups_in(UpgradeState.UNCORDON_REQUIRED)) == 1
+    mgr.apply_state(state, auto_policy())
+    for n in (n0, n1):
+        assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
+    assert not c.get_node(n1.name).spec.unschedulable
+
+
+def test_garbage_state_label_does_not_crash():
+    assert parse_state("definitely-not-a-state") == UpgradeState.UNKNOWN
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h1")
+    n = fx.node(labels={KEYS.state_label: "bogus-state"})
+    fx.driver_pod(n, ds, hash_suffix="h1")
+    mgr = make_manager(c)
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    # Self-heals: treated as unknown, pod in sync -> done.
+    mgr.apply_state(state, auto_policy())
+    assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
+
+
+def test_dcn_anti_affinity_defers_second_slice():
+    """Two slices of one DCN group: only one may be in flight at a time
+    even when slots would allow both."""
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    a = fx.tpu_slice("pool-a", hosts=2, state=UpgradeState.UPGRADE_REQUIRED,
+                     dcn_group="dp-ring-1")
+    b = fx.tpu_slice("pool-b", hosts=2, state=UpgradeState.UPGRADE_REQUIRED,
+                     dcn_group="dp-ring-1")
+    for n in a + b:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    mgr = make_manager(c)
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,  # unlimited slots
+        max_unavailable=IntOrString("100%"),
+        dcn_anti_affinity=True,
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+    states = [
+        {state_of(c, KEYS, n.name) for n in a},
+        {state_of(c, KEYS, n.name) for n in b},
+    ]
+    moved = [s == {UpgradeState.CORDON_REQUIRED.value} for s in states]
+    held = [s == {UpgradeState.UPGRADE_REQUIRED.value} for s in states]
+    assert moved.count(True) == 1 and held.count(True) == 1
+
+    # Without anti-affinity both slices start.
+    c2 = FakeCluster()
+    fx2 = ClusterFixture(c2, KEYS)
+    ds2 = fx2.daemon_set(hash_suffix="h2", revision=2)
+    a2 = fx2.tpu_slice("pool-a", hosts=2, state=UpgradeState.UPGRADE_REQUIRED,
+                       dcn_group="dp-ring-1")
+    b2 = fx2.tpu_slice("pool-b", hosts=2, state=UpgradeState.UPGRADE_REQUIRED,
+                       dcn_group="dp-ring-1")
+    for n in a2 + b2:
+        fx2.driver_pod(n, ds2, hash_suffix="h1")
+    mgr2 = make_manager(c2)
+    policy2 = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        dcn_anti_affinity=False,
+    )
+    mgr2.apply_state(mgr2.build_state(NAMESPACE, DRIVER_LABELS, policy2), policy2)
+    for n in a2 + b2:
+        assert state_of(c2, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+
+
+def test_incomplete_slice_refused():
+    """A slice with fewer visible hosts than its topology expects must not
+    start upgrading (the upgrade itself would split the torus)."""
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    # 2x2x4 v5p topology expects 4 hosts; only 2 are visible.
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x4",
+                         state=UpgradeState.UPGRADE_REQUIRED)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    mgr = make_manager(c)
+    mgr.apply_state(
+        mgr.build_state(NAMESPACE, DRIVER_LABELS),
+        TPUUpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=0),
+    )
+    for n in nodes:
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+
+def test_hosts_per_slice_override_allows_small_slice():
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice("pool-a", hosts=2, state=UpgradeState.UPGRADE_REQUIRED)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    mgr = make_manager(c)
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        topology=SliceTopologySpec(hosts_per_slice=2),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+    for n in nodes:
+        assert state_of(c, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+
+
+def test_health_gate_disable_skips_validation():
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED, unschedulable=True)
+    fx.driver_pod(n, ds, hash_suffix="h2")
+    prober = FakeProber(healthy=False)
+    mgr = make_manager(c).with_validation_enabled(prober)
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        health_gate=SliceHealthGateSpec(enable=False),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+    # Gate disabled: went straight to uncordon, prober never consulted.
+    assert state_of(c, KEYS, n.name) == UpgradeState.UNCORDON_REQUIRED.value
+    assert prober.calls == 0
+
+
+def test_health_gate_timeout_propagates():
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    old = str(int(time.time()) - 100)
+    n = fx.node(
+        state=UpgradeState.VALIDATION_REQUIRED,
+        annotations={KEYS.validation_start_time_annotation: old},
+    )
+    fx.driver_pod(n, None)
+    mgr = make_manager(c).with_validation_enabled(FakeProber(healthy=False))
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        health_gate=SliceHealthGateSpec(timeout_second=30),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+    assert mgr.validation_manager.timeout_seconds == 30
+    assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+
+def test_slice_atomic_false_degroups():
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h1")
+    nodes = fx.tpu_slice("pool-a", hosts=4)
+    for n in nodes:
+        fx.driver_pod(n, ds)
+    mgr = make_manager(c)
+    policy = TPUUpgradePolicySpec(auto_upgrade=True, slice_atomic=False)
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+    assert mgr.get_total_managed_groups(state) == 4
+    for g in state.all_groups():
+        assert g.size() == 1

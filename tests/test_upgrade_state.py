@@ -1,0 +1,858 @@
+"""State-machine integration tests.
+
+The analogue of the reference's ``upgrade_state_test.go`` (38 Its against
+envtest + stateful mocks): a real ClusterUpgradeStateManager against the
+FakeCluster, covering BuildState paths, every processor, the slot math,
+and — new here — slice-atomic group transitions.
+"""
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    IntOrString,
+    PodDeletionSpec,
+    TPUUpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster, PodPhase
+from k8s_operator_libs_tpu.upgrade import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+    ProbeResult,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+
+KEYS = UpgradeKeys()
+
+
+def make_manager(client, **kw):
+    return ClusterUpgradeStateManager(
+        client, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0, **kw
+    )
+
+
+def build(mgr):
+    return mgr.build_state(NAMESPACE, DRIVER_LABELS)
+
+
+def auto_policy(**kw) -> DriverUpgradePolicySpec:
+    return DriverUpgradePolicySpec(auto_upgrade=True, **kw)
+
+
+class FakeProber:
+    def __init__(self, healthy=True):
+        self.healthy = healthy
+        self.calls = 0
+
+    def probe(self, group):
+        self.calls += 1
+        return ProbeResult(self.healthy, "fake")
+
+
+class TestBuildState:
+    def test_happy_path_grouping_by_state_label(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n1 = fx.node(state=UpgradeState.UNKNOWN)
+        n2 = fx.node(state=UpgradeState.DONE)
+        fx.driver_pod(n1, ds)
+        fx.driver_pod(n2, ds)
+        mgr = make_manager(c)
+        state = build(mgr)
+        assert len(state.nodes_in(UpgradeState.UNKNOWN)) == 1
+        assert len(state.nodes_in(UpgradeState.DONE)) == 1
+        nus = state.nodes_in(UpgradeState.DONE)[0]
+        assert nus.node.name == n2.name
+        assert nus.driver_pod.name == f"driver-{n2.name}"
+        assert nus.driver_daemon_set.name == ds.name
+
+    def test_unscheduled_ds_pods_is_error(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n1 = fx.node()
+        fx.driver_pod(n1, ds)
+        # Desired 2 but only 1 pod scheduled (upgrade_state.go:243-246).
+        ds.status.desired_number_scheduled = 2
+        c.update_daemon_set(ds)
+        with pytest.raises(BuildStateError):
+            build(make_manager(c))
+
+    def test_orphaned_pods_have_no_daemonset(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n1 = fx.node()
+        fx.driver_pod(n1, None)  # orphan
+        state = build(make_manager(c))
+        nus = state.nodes_in(UpgradeState.UNKNOWN)[0]
+        assert nus.is_orphaned_pod()
+
+    def test_pending_unscheduled_pod_skipped(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n1 = fx.node()
+        pod = fx.driver_pod(n1, None, phase=PodPhase.PENDING)
+        pod.spec.node_name = ""
+        c.update_pod(pod)
+        state = build(make_manager(c))
+        assert state.node_states == {}
+
+    def test_slice_nodes_grouped_into_one_group(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        nodes = fx.tpu_slice("pool-a", hosts=4)
+        for n in nodes:
+            fx.driver_pod(n, ds)
+        plain = fx.node()
+        fx.driver_pod(plain, ds)
+        state = build(make_manager(c))
+        groups = state.groups_in(UpgradeState.UNKNOWN)
+        assert len(groups) == 2
+        by_id = {g.id: g for g in groups}
+        assert by_id["pool-a"].size() == 4
+        assert by_id["pool-a"].is_slice()
+        assert by_id["pool-a"].slice_info.expected_hosts == 4
+        assert by_id[plain.name].size() == 1
+        assert not by_id[plain.name].is_slice()
+
+    def test_mixed_state_slice_resolves_to_earliest(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n0 = fx.tpu_node("pool-a", 0, state=UpgradeState.CORDON_REQUIRED)
+        n1 = fx.tpu_node("pool-a", 1, state=UpgradeState.UPGRADE_REQUIRED)
+        for n in (n0, n1):
+            fx.driver_pod(n, ds)
+        state = build(make_manager(c))
+        assert len(state.groups_in(UpgradeState.UPGRADE_REQUIRED)) == 1
+
+    def test_failed_member_dominates_group_state(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n0 = fx.tpu_node("pool-a", 0, state=UpgradeState.FAILED)
+        n1 = fx.tpu_node("pool-a", 1, state=UpgradeState.POD_RESTART_REQUIRED)
+        for n in (n0, n1):
+            fx.driver_pod(n, ds)
+        state = build(make_manager(c))
+        assert len(state.groups_in(UpgradeState.FAILED)) == 1
+
+
+class TestDoneOrUnknown:
+    def test_unknown_with_synced_pod_becomes_done(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h1")
+        n = fx.node()
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
+
+    def test_unknown_with_outdated_pod_requires_upgrade(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node()
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+    def test_done_with_outdated_pod_requires_upgrade(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.DONE)
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+    def test_orphaned_pod_stays_until_requested(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        # Orphan without request: unknown -> done (upgrade_state.go:509,535)
+        assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
+        # Now request the upgrade via annotation.
+        c.patch_node_annotations(
+            n.name, {KEYS.upgrade_requested_annotation: "true"}
+        )
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+    def test_safe_load_waiting_forces_upgrade(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h1")
+        n = fx.node()
+        fx.driver_pod(n, ds, hash_suffix="h1")  # in sync!
+        c.patch_node_annotations(n.name, {KEYS.safe_load_annotation: "true"})
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+    def test_unschedulable_node_tracked_in_annotation(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(unschedulable=True)
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        node = c.get_node(n.name)
+        assert node.annotations[KEYS.initial_state_annotation] == "true"
+
+    def test_outdated_host_upgrades_whole_slice(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        nodes = fx.tpu_slice("pool-a", hosts=4)
+        # Only one host outdated; slice still moves as a unit.
+        fx.driver_pod(nodes[0], ds, hash_suffix="h1")
+        for n in nodes[1:]:
+            fx.driver_pod(n, ds, hash_suffix="h2")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        for n in nodes:
+            assert (
+                state_of(c, KEYS, n.name)
+                == UpgradeState.UPGRADE_REQUIRED.value
+            )
+
+
+class TestUpgradeRequiredSlots:
+    def _pool(self, c, fx, count, hash_ds="h2", hash_pod="h1"):
+        ds = fx.daemon_set(hash_suffix=hash_ds, revision=2)
+        nodes = [fx.node(state=UpgradeState.UPGRADE_REQUIRED) for _ in range(count)]
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix=hash_pod)
+        return nodes
+
+    def test_max_parallel_limits_cordon(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        nodes = self._pool(c, fx, 5)
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(max_parallel_upgrades=3, max_unavailable=IntOrString("100%")),
+        )
+        moved = [
+            n
+            for n in nodes
+            if state_of(c, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+        ]
+        assert len(moved) == 3
+
+    def test_max_parallel_zero_is_unlimited(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        nodes = self._pool(c, fx, 5)
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(max_parallel_upgrades=0, max_unavailable=IntOrString("100%")),
+        )
+        for n in nodes:
+            assert state_of(c, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+
+    def test_max_unavailable_caps_slots(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        nodes = self._pool(c, fx, 4)
+        mgr = make_manager(c)
+        # maxParallel unlimited but 25% of 4 nodes = 1 unavailable allowed.
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(max_parallel_upgrades=0, max_unavailable=IntOrString("25%")),
+        )
+        moved = [
+            n
+            for n in nodes
+            if state_of(c, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+        ]
+        assert len(moved) == 1
+
+    def test_cordoned_nodes_count_against_max_unavailable(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        nodes = [fx.node(state=UpgradeState.UPGRADE_REQUIRED) for _ in range(3)]
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="h1")
+        # One unrelated cordoned node in the pool consumes the budget.
+        extra = fx.node(state=UpgradeState.DONE, unschedulable=True)
+        fx.driver_pod(extra, ds, hash_suffix="h2")
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(max_parallel_upgrades=0, max_unavailable=IntOrString(1)),
+        )
+        moved = [
+            n
+            for n in nodes
+            if state_of(c, KEYS, n.name) == UpgradeState.CORDON_REQUIRED.value
+        ]
+        assert len(moved) == 0
+
+    def test_already_cordoned_bypasses_slot_limit(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        cordoned = fx.node(state=UpgradeState.UPGRADE_REQUIRED, unschedulable=True)
+        fx.driver_pod(cordoned, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        # Zero slots available (maxUnavailable=0) but manually cordoned
+        # nodes progress anyway (upgrade_state.go:606-616).
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(max_parallel_upgrades=1, max_unavailable=IntOrString(0)),
+        )
+        assert (
+            state_of(c, KEYS, cordoned.name)
+            == UpgradeState.CORDON_REQUIRED.value
+        )
+
+    def test_skip_label_honored(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.UPGRADE_REQUIRED,
+                    labels={KEYS.skip_label: "true"})
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy(max_parallel_upgrades=0))
+        assert state_of(c, KEYS, n.name) == UpgradeState.UPGRADE_REQUIRED.value
+
+    def test_upgrade_requested_annotation_removed(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(
+            state=UpgradeState.UPGRADE_REQUIRED,
+            annotations={KEYS.upgrade_requested_annotation: "true"},
+        )
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            KEYS.upgrade_requested_annotation
+            not in c.get_node(n.name).annotations
+        )
+
+    def test_slice_unit_slot_accounting(self):
+        """maxParallelUpgrades=1 with slice units: one whole slice (4 hosts)
+        moves; the second slice waits."""
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        a = fx.tpu_slice("pool-a", hosts=4, state=UpgradeState.UPGRADE_REQUIRED)
+        b = fx.tpu_slice("pool-b", hosts=4, state=UpgradeState.UPGRADE_REQUIRED)
+        for n in a + b:
+            fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        policy = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("50%"),
+        )
+        mgr.apply_state(build(mgr), policy)
+        states_a = {state_of(c, KEYS, n.name) for n in a}
+        states_b = {state_of(c, KEYS, n.name) for n in b}
+        assert (
+            states_a == {UpgradeState.CORDON_REQUIRED.value}
+            and states_b == {UpgradeState.UPGRADE_REQUIRED.value}
+        ) or (
+            states_b == {UpgradeState.CORDON_REQUIRED.value}
+            and states_a == {UpgradeState.UPGRADE_REQUIRED.value}
+        )
+
+
+class TestCordonToDrain:
+    def test_cordon_advances_to_wait_for_jobs(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.CORDON_REQUIRED)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert c.get_node(n.name).spec.unschedulable
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.WAIT_FOR_JOBS_REQUIRED.value
+        )
+
+    def test_wait_for_jobs_no_selector_pod_deletion_disabled(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.DRAIN_REQUIRED.value
+
+    def test_wait_for_jobs_no_selector_pod_deletion_enabled(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c).with_pod_deletion_enabled(lambda p: False)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_DELETION_REQUIRED.value
+        )
+
+    def test_wait_for_jobs_waits_while_running(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, labels={"job": "train"})
+        mgr = make_manager(c)
+        spec = WaitForCompletionSpec(pod_selector="job=train")
+        mgr.apply_state(build(mgr), auto_policy(wait_for_completion=spec))
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.WAIT_FOR_JOBS_REQUIRED.value
+        )
+
+    def test_wait_for_jobs_advances_when_jobs_done(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, labels={"job": "train"}, phase=PodPhase.SUCCEEDED)
+        mgr = make_manager(c)
+        spec = WaitForCompletionSpec(pod_selector="job=train")
+        mgr.apply_state(build(mgr), auto_policy(wait_for_completion=spec))
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_DELETION_REQUIRED.value
+        )
+
+    def test_wait_for_jobs_timeout_advances(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        old = str(int(time.time()) - 100)
+        n = fx.node(
+            state=UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+            annotations={KEYS.pod_completion_start_time_annotation: old},
+        )
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, labels={"job": "train"})  # still running
+        mgr = make_manager(c)
+        spec = WaitForCompletionSpec(pod_selector="job=train", timeout_second=30)
+        mgr.apply_state(build(mgr), auto_policy(wait_for_completion=spec))
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_DELETION_REQUIRED.value
+        )
+
+    def test_pod_deletion_disabled_goes_to_drain(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.POD_DELETION_REQUIRED)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.DRAIN_REQUIRED.value
+
+    def test_pod_deletion_deletes_matching_pods(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.POD_DELETION_REQUIRED)
+        fx.driver_pod(n, None)
+        doomed = fx.workload_pod(n, labels={"delete-me": "yes"})
+        safe = fx.workload_pod(n, labels={"keep": "yes"})
+        mgr = make_manager(c).with_pod_deletion_enabled(
+            lambda p: p.labels.get("delete-me") == "yes"
+        )
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(pod_deletion=PodDeletionSpec(timeout_second=5)),
+        )
+        assert mgr.wait_for_async_work()
+        names = {p.name for p in c.list_pods(node_name=n.name)}
+        assert doomed.name not in names
+        assert safe.name in names
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+
+    def test_pod_deletion_failure_falls_back_to_drain(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.POD_DELETION_REQUIRED)
+        fx.driver_pod(n, None)
+        # Orphan workload (no controller) cannot be deleted without force.
+        orphan = fx.workload_pod(n, labels={"delete-me": "yes"}, owned=False)
+        mgr = make_manager(c).with_pod_deletion_enabled(
+            lambda p: p.labels.get("delete-me") == "yes"
+        )
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(
+                pod_deletion=PodDeletionSpec(force=False, timeout_second=5),
+                drain_spec=DrainSpec(enable=True),
+            ),
+        )
+        assert mgr.wait_for_async_work()
+        assert state_of(c, KEYS, n.name) == UpgradeState.DRAIN_REQUIRED.value
+
+    def test_pod_deletion_failure_without_drain_fails(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.POD_DELETION_REQUIRED)
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, labels={"delete-me": "yes"}, owned=False)
+        mgr = make_manager(c).with_pod_deletion_enabled(
+            lambda p: p.labels.get("delete-me") == "yes"
+        )
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(pod_deletion=PodDeletionSpec(force=False, timeout_second=5)),
+        )
+        assert mgr.wait_for_async_work()
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+    def test_drain_disabled_goes_to_pod_restart(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.DRAIN_REQUIRED)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+
+    def test_drain_evicts_workloads_and_advances(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n = fx.node(state=UpgradeState.DRAIN_REQUIRED)
+        fx.driver_pod(n, ds)
+        wl = fx.workload_pod(n)
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(drain_spec=DrainSpec(enable=True, timeout_second=5)),
+        )
+        assert mgr.wait_for_async_work()
+        names = {p.name for p in c.list_pods(node_name=n.name)}
+        assert wl.name not in names
+        assert f"driver-{n.name}" in names  # DS pod survives drain
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+
+    def test_drain_error_fails_node(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.DRAIN_REQUIRED)
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, owned=False)  # undeletable without force
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            auto_policy(drain_spec=DrainSpec(enable=True, force=False,
+                                             timeout_second=5)),
+        )
+        assert mgr.wait_for_async_work()
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+    def test_slice_drain_is_atomic(self):
+        """All 4 hosts of a slice drain in one worker and flip state at the
+        group barrier."""
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        nodes = fx.tpu_slice("pool-a", hosts=4,
+                             state=UpgradeState.DRAIN_REQUIRED)
+        for n in nodes:
+            fx.driver_pod(n, ds)
+            fx.workload_pod(n)
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            TPUUpgradePolicySpec(
+                auto_upgrade=True,
+                drain_spec=DrainSpec(enable=True, timeout_second=5),
+            ),
+        )
+        assert mgr.wait_for_async_work()
+        for n in nodes:
+            assert (
+                state_of(c, KEYS, n.name)
+                == UpgradeState.POD_RESTART_REQUIRED.value
+            )
+            assert c.get_node(n.name).spec.unschedulable
+
+    def test_slice_drain_failure_fails_whole_slice(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        nodes = fx.tpu_slice("pool-a", hosts=4,
+                             state=UpgradeState.DRAIN_REQUIRED)
+        for n in nodes:
+            fx.driver_pod(n, ds)
+        # One host has an undrainable pod.
+        fx.workload_pod(nodes[2], owned=False)
+        mgr = make_manager(c)
+        mgr.apply_state(
+            build(mgr),
+            TPUUpgradePolicySpec(
+                auto_upgrade=True,
+                drain_spec=DrainSpec(enable=True, timeout_second=5),
+            ),
+        )
+        assert mgr.wait_for_async_work()
+        for n in nodes:
+            assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+
+class TestPodRestartToDone:
+    def test_outdated_pod_restarted(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED)
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        fx.auto_recreate_driver_pods(ds, "h2")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        pods = c.list_pods(node_name=n.name)
+        assert pods[0].labels["controller-revision-hash"] == "h2"
+        # Node stays in pod-restart until next pass sees the synced pod.
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.UNCORDON_REQUIRED.value
+        )
+
+    def test_terminating_pod_not_restarted(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED)
+        fx.driver_pod(n, ds, hash_suffix="h1", terminating=True)
+        deleted = []
+        c.on_pod_deleted(lambda p: deleted.append(p.name))
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert deleted == []
+
+    def test_synced_ready_with_validation_goes_to_validation(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED)
+        fx.driver_pod(n, ds, hash_suffix="h2")
+        mgr = make_manager(c).with_validation_enabled("app=validator")
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.VALIDATION_REQUIRED.value
+        )
+
+    def test_crash_looping_new_driver_fails(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED)
+        fx.driver_pod(n, ds, hash_suffix="h2", ready=False, restart_count=11)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+    def test_not_ready_low_restarts_waits(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.POD_RESTART_REQUIRED)
+        fx.driver_pod(n, ds, hash_suffix="h2", ready=False, restart_count=2)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+
+    def test_safe_load_unblocked_when_slice_quiesced(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(
+            state=UpgradeState.POD_RESTART_REQUIRED,
+            annotations={KEYS.safe_load_annotation: "true"},
+        )
+        fx.driver_pod(n, ds, hash_suffix="h2")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert KEYS.safe_load_annotation not in c.get_node(n.name).annotations
+
+    def test_failed_group_recovers_when_pods_back_in_sync(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.FAILED)
+        fx.driver_pod(n, ds, hash_suffix="h2")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.UNCORDON_REQUIRED.value
+        )
+
+    def test_initially_cordoned_node_skips_uncordon(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(
+            state=UpgradeState.POD_RESTART_REQUIRED,
+            unschedulable=True,
+            annotations={KEYS.initial_state_annotation: "true"},
+        )
+        fx.driver_pod(n, ds, hash_suffix="h2")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        node = c.get_node(n.name)
+        assert node.labels[KEYS.state_label] == UpgradeState.DONE.value
+        assert node.spec.unschedulable  # stayed cordoned
+        assert KEYS.initial_state_annotation not in node.annotations
+
+    def test_uncordon_required_advances_to_done(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.UNCORDON_REQUIRED, unschedulable=True)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), auto_policy())
+        node = c.get_node(n.name)
+        assert node.labels[KEYS.state_label] == UpgradeState.DONE.value
+        assert not node.spec.unschedulable
+
+
+class TestValidation:
+    def test_prober_failure_holds_state(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.VALIDATION_REQUIRED)
+        fx.driver_pod(n, None)
+        prober = FakeProber(healthy=False)
+        mgr = make_manager(c).with_validation_enabled(prober)
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.VALIDATION_REQUIRED.value
+        )
+        assert prober.calls == 1
+        # Start-time annotation stamped for the timeout clock.
+        assert (
+            KEYS.validation_start_time_annotation
+            in c.get_node(n.name).annotations
+        )
+
+    def test_prober_success_advances(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.VALIDATION_REQUIRED, unschedulable=True)
+        fx.driver_pod(n, None)
+        mgr = make_manager(c).with_validation_enabled(FakeProber(healthy=True))
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.UNCORDON_REQUIRED.value
+        )
+
+    def test_validation_timeout_fails(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        old = str(int(time.time()) - 1000)
+        n = fx.node(
+            state=UpgradeState.VALIDATION_REQUIRED,
+            annotations={KEYS.validation_start_time_annotation: old},
+        )
+        fx.driver_pod(n, None)
+        mgr = make_manager(c).with_validation_enabled(FakeProber(healthy=False))
+        mgr.validation_manager.timeout_seconds = 600
+        mgr.apply_state(build(mgr), auto_policy())
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+
+    def test_pod_validation_prober(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(state=UpgradeState.VALIDATION_REQUIRED, unschedulable=True)
+        fx.driver_pod(n, None)
+        fx.workload_pod(n, labels={"app": "validator"})
+        mgr = make_manager(c).with_validation_enabled("app=validator")
+        mgr.apply_state(build(mgr), auto_policy())
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.UNCORDON_REQUIRED.value
+        )
+
+
+class TestPolicyGate:
+    def test_auto_upgrade_disabled_is_noop(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node()
+        fx.driver_pod(n, ds, hash_suffix="h1")
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), DriverUpgradePolicySpec(auto_upgrade=False))
+        assert state_of(c, KEYS, n.name) == ""
+
+    def test_none_policy_is_noop(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        fx.driver_pod(n, None)
+        mgr = make_manager(c)
+        mgr.apply_state(build(mgr), None)
+        assert state_of(c, KEYS, n.name) == ""
+
+    def test_none_state_raises(self):
+        mgr = make_manager(FakeCluster())
+        with pytest.raises(ValueError):
+            mgr.apply_state(None, auto_policy())
+
+
+class TestCounters:
+    def test_counters(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        fx.driver_pod(fx.node(state=UpgradeState.DONE), None)
+        fx.driver_pod(fx.node(state=UpgradeState.UPGRADE_REQUIRED), None)
+        fx.driver_pod(fx.node(state=UpgradeState.DRAIN_REQUIRED), None)
+        fx.driver_pod(fx.node(state=UpgradeState.FAILED), None)
+        mgr = make_manager(c)
+        state = build(mgr)
+        assert mgr.get_total_managed_nodes(state) == 4
+        assert mgr.get_upgrades_done(state) == 1
+        assert mgr.get_upgrades_pending(state) == 1
+        assert mgr.get_upgrades_failed(state) == 1
+        # drain-required + failed are in progress
+        assert mgr.get_upgrades_in_progress(state) == 2
+        assert mgr.get_total_managed_groups(state) == 4
