@@ -1,0 +1,53 @@
+"""TPU slice health backend.
+
+The genuinely new first-class component relative to the reference
+(SURVEY.md §2.3, §5, §7 step 5): the reference's ValidationManager can only
+check that an out-of-repo validation pod is Ready
+(validation_manager.go:71-136) — the actual health check (nvidia-smi) lives
+in consumer operators.  Here the health check is in-repo and TPU-native:
+
+- :mod:`probes` — JAX/XLA probe computations: device enumeration, MXU
+  matmul with an analytic result check, HBM-bandwidth streaming, ICI
+  all-reduce (psum over a device mesh) and per-link ring (ppermute)
+  verification;
+- :mod:`report` — the serializable per-host :class:`HealthReport` that a
+  node agent publishes as a node annotation;
+- :mod:`agent` — the node-side probe agent (runs in the validation
+  DaemonSet, one pod per TPU host, optionally `jax.distributed` across the
+  slice);
+- :mod:`slice_prober` — controller-side probers implementing the
+  ``SliceProber`` protocol consumed by
+  ``upgrade.validation_manager.ValidationManager``.
+"""
+
+from k8s_operator_libs_tpu.health.probes import (
+    CheckResult,
+    device_inventory,
+    hbm_bandwidth_probe,
+    ici_allreduce_probe,
+    ici_ring_probe,
+    matmul_probe,
+    run_host_probe,
+)
+from k8s_operator_libs_tpu.health.report import (
+    HEALTH_CHECKS_ALL,
+    HealthReport,
+)
+from k8s_operator_libs_tpu.health.slice_prober import (
+    LocalDeviceProber,
+    NodeReportProber,
+)
+
+__all__ = [
+    "CheckResult",
+    "HealthReport",
+    "HEALTH_CHECKS_ALL",
+    "LocalDeviceProber",
+    "NodeReportProber",
+    "device_inventory",
+    "hbm_bandwidth_probe",
+    "ici_allreduce_probe",
+    "ici_ring_probe",
+    "matmul_probe",
+    "run_host_probe",
+]

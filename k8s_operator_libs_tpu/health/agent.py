@@ -1,0 +1,164 @@
+"""Node-side probe agent.
+
+Runs inside the validation DaemonSet, one pod per TPU host (host networking,
+``spec.nodeName`` downward-API env).  Each cycle it runs the JAX probe
+battery and publishes the resulting
+:class:`~k8s_operator_libs_tpu.health.report.HealthReport` as a node
+annotation, where the controller-side
+:class:`~k8s_operator_libs_tpu.health.slice_prober.NodeReportProber`
+aggregates per-host reports into the slice verdict.
+
+For a multi-host slice the agents coordinate through ``jax.distributed``
+(GKE injects ``TPU_WORKER_HOSTNAMES`` / ``MEGASCALE_COORDINATOR_ADDRESS``
+style env; we honor JAX's standard auto-detection): then
+``jax.devices()`` spans the whole torus and the ICI all-reduce probe *is*
+the slice re-formation check.  Single-host agents probe their local chips
+only and set ``slice_wide=False``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.health.probes import run_host_probe
+from k8s_operator_libs_tpu.health.report import HealthReport
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+
+logger = get_logger(__name__)
+
+# Set by the downward API in the agent DaemonSet spec.
+NODE_NAME_ENV = "NODE_NAME"
+# Driver revision the agent probes under; injected by the controller via
+# the DaemonSet template (so it changes exactly when the driver does).
+DRIVER_REVISION_ENV = "DRIVER_REVISION"
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialize ``jax.distributed`` when multi-host env is present.
+
+    GKE TPU pods are injected with ``TPU_WORKER_HOSTNAMES`` (and
+    megascale coordinator env on multi-slice); jax.distributed.initialize
+    auto-detects the TPU cluster from those.  An explicit coordinator
+    address is also honored.  Returns True when the process participates
+    in a multi-process JAX runtime (then ``jax.devices()`` spans the whole
+    slice and the ICI all-reduce probe is the re-formation check)."""
+    hostnames = [
+        h
+        for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+        if h.strip()
+    ]
+    explicit = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if explicit or len(hostnames) > 1:
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            # Already initialized (idempotent re-entry) is fine.
+            if "already" not in str(e).lower():
+                raise
+    return jax.process_count() > 1
+
+
+class HealthAgent:
+    """Probe-and-publish loop for one TPU host."""
+
+    def __init__(
+        self,
+        client,
+        node_name: str,
+        keys: Optional[UpgradeKeys] = None,
+        driver_revision: str = "",
+        devices: Optional[Sequence[jax.Device]] = None,
+        slice_wide: bool = False,
+        matmul_n: int = 2048,
+        hbm_mib: int = 256,
+        allreduce_elems: int = 1 << 20,
+    ) -> None:
+        self.client = client
+        self.node_name = node_name
+        self.keys = keys or UpgradeKeys()
+        self.driver_revision = driver_revision
+        self.devices = list(devices) if devices is not None else None
+        self.slice_wide = slice_wide
+        self.matmul_n = matmul_n
+        self.hbm_mib = hbm_mib
+        self.allreduce_elems = allreduce_elems
+
+    def probe_once(self) -> HealthReport:
+        checks = run_host_probe(
+            self.devices,
+            matmul_n=self.matmul_n,
+            hbm_mib=self.hbm_mib,
+            allreduce_elems=self.allreduce_elems,
+        )
+        devs = (
+            len(self.devices)
+            if self.devices is not None
+            else len(jax.devices())
+        )
+        return HealthReport(
+            node_name=self.node_name,
+            driver_revision=self.driver_revision,
+            checks=checks,
+            timestamp=time.time(),
+            visible_devices=devs,
+            slice_wide=self.slice_wide,
+        )
+
+    def publish(self, report: HealthReport) -> None:
+        self.client.patch_node_annotations(
+            self.node_name,
+            {self.keys.health_report_annotation: report.to_json()},
+        )
+
+    def run_once(self) -> HealthReport:
+        report = self.probe_once()
+        self.publish(report)
+        logger.info(
+            "published health report for %s: healthy=%s",
+            self.node_name,
+            report.healthy,
+        )
+        return report
+
+    def run_forever(self, interval_s: float = 30.0) -> None:
+        """Probe/publish until the process is killed (DaemonSet lifecycle).
+        Probe failures are published, not raised: an unhealthy report *is*
+        the signal the controller needs."""
+        while True:
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — agent must stay alive
+                logger.exception("health probe cycle failed")
+            time.sleep(interval_s)
+
+
+def main() -> None:
+    """Entrypoint for the agent container:
+    ``python -m k8s_operator_libs_tpu.health.agent``."""
+    from k8s_operator_libs_tpu.k8s import get_default_client
+
+    node_name = os.environ.get(NODE_NAME_ENV, "")
+    if not node_name:
+        raise SystemExit(f"{NODE_NAME_ENV} is required")
+    slice_wide = maybe_initialize_distributed()
+    agent = HealthAgent(
+        client=get_default_client(),
+        node_name=node_name,
+        driver_revision=os.environ.get(DRIVER_REVISION_ENV, ""),
+        slice_wide=slice_wide,
+    )
+    interval = float(os.environ.get("HEALTH_PROBE_INTERVAL_S", "30"))
+    agent.run_forever(interval)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,350 @@
+"""JAX/XLA health-probe computations.
+
+Each probe is a small, jit-compiled XLA program with a host-side
+verification of an analytically-known result, so a probe failure
+distinguishes "the math came out wrong" (broken chip / driver) from "the
+program didn't run" (device lost / hang → exception or timeout handled by
+the caller).  The probes map one-to-one onto the failure domains of a TPU
+host after a libtpu upgrade:
+
+- **device enumeration** — libtpu loaded and all chips visible (the
+  TPU-native replacement for the reference's out-of-repo nvidia-smi
+  validation pod, SURVEY.md §2.3);
+- **MXU matmul** — the systolic array multiplies correctly (bf16 inputs,
+  f32 accumulation, large static shapes so XLA tiles onto the MXU);
+- **HBM bandwidth** — a streaming read+write loop achieves sane bandwidth
+  (catches the degraded-HBM failure mode that enumerates fine);
+- **ICI all-reduce** — `psum` over every chip of the mesh completes and is
+  numerically exact: "the slice re-formed" (BASELINE north star's 100 %
+  slice re-formation gate);
+- **ICI ring** — `ppermute` by +1 verifies each directed neighbor link
+  individually, so a single flaky ICI link is attributable, not just a
+  slow/global all-reduce failure.
+
+Probes run identically on TPU and on a virtual multi-device CPU backend
+(tests, dry-runs): only the XLA target differs.  All control flow is
+static; verification happens on host after ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_operator_libs_tpu.consts import get_logger
+
+logger = get_logger(__name__)
+
+# One ICI mesh axis: a slice is one torus; the probe reduces over all of it.
+ICI_AXIS = "ici"
+
+
+@dataclass
+class CheckResult:
+    """Outcome of one probe."""
+
+    name: str
+    ok: bool
+    latency_ms: float = 0.0
+    detail: str = ""
+    # Free-form numeric side channel (e.g. tflops, gbps) for metrics/bench.
+    metrics: dict[str, float] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "latency_ms": round(self.latency_ms, 3),
+            "detail": self.detail,
+            "metrics": {k: round(v, 3) for k, v in self.metrics.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CheckResult":
+        return CheckResult(
+            name=d.get("name", ""),
+            ok=bool(d.get("ok", False)),
+            latency_ms=float(d.get("latency_ms", 0.0)),
+            detail=d.get("detail", ""),
+            metrics=dict(d.get("metrics", {})),
+        )
+
+
+def _timed(fn, *args) -> tuple[float, object]:
+    """Run ``fn`` once for compile warmup, then time one synchronous call."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) * 1e3, out
+
+
+def device_inventory(
+    devices: Optional[Sequence[jax.Device]] = None,
+    expected_devices: int = 0,
+) -> CheckResult:
+    """Enumerate accelerator devices: libtpu loaded, chips visible.
+
+    ``expected_devices`` > 0 additionally asserts the count (per-host chip
+    count from the slice topology, or global chip count under
+    ``jax.distributed``)."""
+    t0 = time.perf_counter()
+    try:
+        devs = list(devices) if devices is not None else list(jax.devices())
+    except RuntimeError as e:  # no backend at all — driver not loaded
+        return CheckResult(
+            "device_enumeration", False, 0.0, f"device enumeration failed: {e}"
+        )
+    latency_ms = (time.perf_counter() - t0) * 1e3
+    kinds = sorted({d.device_kind for d in devs})
+    ok = len(devs) > 0
+    detail = f"{len(devs)} device(s): {', '.join(kinds)}"
+    if expected_devices and len(devs) != expected_devices:
+        ok = False
+        detail += f" (expected {expected_devices})"
+    return CheckResult(
+        "device_enumeration",
+        ok,
+        latency_ms,
+        detail,
+        {"devices": float(len(devs))},
+    )
+
+
+def matmul_probe(
+    device: Optional[jax.Device] = None, n: int = 2048, dtype=jnp.bfloat16
+) -> CheckResult:
+    """MXU correctness + throughput: ``C = A @ B`` with an analytic result.
+
+    A is filled with ``a``, B with ``b`` ⇒ every C element equals
+    ``n*a*b`` exactly (bf16 operands are exact for these small constants
+    and accumulation is forced to f32 via ``preferred_element_type``), so
+    any deviation is a real compute fault, not rounding."""
+    if device is None:
+        device = jax.devices()[0]
+    a_val, b_val = 0.5, 0.25
+    expected = n * a_val * b_val
+
+    @jax.jit
+    def mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+
+    try:
+        a = jax.device_put(jnp.full((n, n), a_val, dtype=dtype), device)
+        b = jax.device_put(jnp.full((n, n), b_val, dtype=dtype), device)
+        latency_ms, out = _timed(mm, a, b)
+        got = np.asarray(out)
+    except Exception as e:  # noqa: BLE001 — any device fault fails the check
+        return CheckResult("mxu_matmul", False, 0.0, f"matmul failed: {e}")
+    exact = bool(np.all(got == expected))
+    tflops = (2.0 * n * n * n) / (latency_ms * 1e-3) / 1e12
+    return CheckResult(
+        "mxu_matmul",
+        exact,
+        latency_ms,
+        "exact" if exact else
+        f"matmul result mismatch: expected {expected}, got "
+        f"[{got.min()}, {got.max()}]",
+        {"tflops": tflops, "n": float(n)},
+    )
+
+
+def hbm_bandwidth_probe(
+    device: Optional[jax.Device] = None, mib: int = 256
+) -> CheckResult:
+    """Streaming HBM read+write: ``y = x + 1`` over a ``mib``-MiB f32 array.
+
+    Catches the silently-degraded-HBM failure mode.  The check itself
+    verifies the add (content check on a sample), the bandwidth figure is
+    surfaced as a metric for threshold policies in the prober."""
+    if device is None:
+        device = jax.devices()[0]
+    elems = (mib * 1024 * 1024) // 4
+
+    @jax.jit
+    def stream(x):
+        return x + 1.0
+
+    try:
+        x = jax.device_put(jnp.zeros((elems,), jnp.float32), device)
+        latency_ms, out = _timed(stream, x)
+        sample = np.asarray(out[:8])
+    except Exception as e:  # noqa: BLE001
+        return CheckResult("hbm_bandwidth", False, 0.0, f"stream failed: {e}")
+    ok = bool(np.all(sample == 1.0))
+    nbytes = elems * 4 * 2  # read + write
+    gbps = nbytes / (latency_ms * 1e-3) / 1e9
+    return CheckResult(
+        "hbm_bandwidth",
+        ok,
+        latency_ms,
+        f"{gbps:.1f} GB/s over {mib} MiB" if ok else "stream content mismatch",
+        {"gbps": gbps, "mib": float(mib)},
+    )
+
+
+def _make_ici_mesh(devices: Sequence[jax.Device]) -> Mesh:
+    return Mesh(np.asarray(devices), (ICI_AXIS,))
+
+
+def ici_allreduce_probe(
+    devices: Optional[Sequence[jax.Device]] = None,
+    per_device_elems: int = 1 << 20,
+) -> CheckResult:
+    """All-reduce (`psum`) across every chip of the slice mesh.
+
+    Device ``i`` contributes the constant ``i+1`` ⇒ every shard of the
+    result must equal ``n(n+1)/2`` exactly.  Success means the torus
+    re-formed end-to-end — the north-star "100 % slice re-formation"
+    predicate.  Also reports ring-all-reduce bus bandwidth."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if n < 2:
+        return CheckResult(
+            "ici_allreduce", True, 0.0, "single device; no ICI to probe",
+            {"devices": float(n)},
+        )
+    mesh = _make_ici_mesh(devs)
+    expected = n * (n + 1) / 2.0
+
+    def body(x):
+        return jax.lax.psum(x, ICI_AXIS)
+
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P(ICI_AXIS), out_specs=P(ICI_AXIS)
+        )
+    )
+    try:
+        # ramp: rows of constant (i+1), row i sharded onto device i.
+        host = np.repeat(
+            np.arange(1.0, n + 1.0, dtype=np.float32)[:, None],
+            per_device_elems,
+            axis=1,
+        )
+        x = jax.device_put(host, NamedSharding(mesh, P(ICI_AXIS)))
+        latency_ms, out = _timed(fn, x)
+        got = np.asarray(out)
+    except Exception as e:  # noqa: BLE001
+        return CheckResult(
+            "ici_allreduce", False, 0.0, f"all-reduce failed: {e}"
+        )
+    exact = bool(np.all(got == expected))
+    # Ring all-reduce moves 2(n-1)/n of the buffer over each link.
+    shard_bytes = per_device_elems * 4
+    busbw = (2.0 * (n - 1) / n) * shard_bytes / (latency_ms * 1e-3) / 1e9
+    return CheckResult(
+        "ici_allreduce",
+        exact,
+        latency_ms,
+        f"psum over {n} devices exact" if exact else
+        f"psum mismatch: expected {expected}, got "
+        f"[{got.min()}, {got.max()}]",
+        {"devices": float(n), "busbw_gbps": busbw},
+    )
+
+
+def ici_ring_probe(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> CheckResult:
+    """Per-link verification: ``ppermute`` every shard to its +1 ring
+    neighbor; shard ``i`` must then hold ``i-1 (mod n)``.
+
+    A failure here names the broken *link* (the first position whose
+    received value is wrong), where the all-reduce probe can only say "the
+    collective didn't complete"."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if n < 2:
+        return CheckResult(
+            "ici_ring", True, 0.0, "single device; no links to probe",
+            {"devices": float(n)},
+        )
+    mesh = _make_ici_mesh(devs)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        return jax.lax.ppermute(x, ICI_AXIS, perm)
+
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P(ICI_AXIS), out_specs=P(ICI_AXIS)
+        )
+    )
+    try:
+        x = jax.device_put(
+            np.arange(n, dtype=np.float32)[:, None],
+            NamedSharding(mesh, P(ICI_AXIS)),
+        )
+        latency_ms, out = _timed(fn, x)
+        got = np.asarray(out)[:, 0]
+    except Exception as e:  # noqa: BLE001
+        return CheckResult("ici_ring", False, 0.0, f"ppermute failed: {e}")
+    expected = np.roll(np.arange(n, dtype=np.float32), 1)
+    bad = np.nonzero(got != expected)[0]
+    if bad.size:
+        first = int(bad[0])
+        return CheckResult(
+            "ici_ring",
+            False,
+            latency_ms,
+            f"link {(first - 1) % n}->{first} delivered {got[first]}, "
+            f"expected {expected[first]}",
+            {"devices": float(n), "bad_links": float(bad.size)},
+        )
+    return CheckResult(
+        "ici_ring",
+        True,
+        latency_ms,
+        f"all {n} ring links verified",
+        {"devices": float(n)},
+    )
+
+
+def run_host_probe(
+    devices: Optional[Sequence[jax.Device]] = None,
+    expected_devices: int = 0,
+    matmul_n: int = 2048,
+    hbm_mib: int = 256,
+    allreduce_elems: int = 1 << 20,
+    skip_ici: bool = False,
+) -> list[CheckResult]:
+    """Run the full probe battery; returns every check's result.
+
+    Fail-fast on enumeration (nothing else can run without devices), then
+    run every remaining probe even if one fails — the per-check results
+    are what make a slice-health verdict attributable."""
+    try:
+        devs = list(devices) if devices is not None else list(jax.devices())
+    except RuntimeError as e:  # no backend at all — driver not loaded
+        return [
+            CheckResult(
+                "device_enumeration",
+                False,
+                0.0,
+                f"device enumeration failed: {e}",
+            )
+        ]
+    results = [device_inventory(devs, expected_devices)]
+    if not devs:
+        return results
+    # Single-device probes must run on a device THIS process addresses:
+    # under jax.distributed the global device list spans hosts, and
+    # device_put onto a non-addressable device raises.
+    local = [d for d in devs if d.process_index == jax.process_index()]
+    probe_dev = local[0] if local else devs[0]
+    results.append(matmul_probe(probe_dev, n=matmul_n))
+    results.append(hbm_bandwidth_probe(probe_dev, mib=hbm_mib))
+    if not skip_ici:
+        results.append(
+            ici_allreduce_probe(devs, per_device_elems=allreduce_elems)
+        )
+        results.append(ici_ring_probe(devs))
+    return results

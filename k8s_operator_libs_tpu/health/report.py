@@ -1,0 +1,95 @@
+"""The per-host health report and its node-annotation wire format.
+
+The reference persists all upgrade state *into the cluster* as node
+labels/annotations so the stateless reconcile survives restarts
+(SURVEY.md §5 "checkpoint/resume").  The health backend follows the same
+pattern: each TPU host's probe agent publishes a :class:`HealthReport` as
+a JSON node annotation, and the controller-side
+:class:`~k8s_operator_libs_tpu.health.slice_prober.NodeReportProber`
+aggregates the per-host reports into a slice verdict.  The report carries
+the driver revision it was probed under, so a stale report from before
+the driver restart can never validate the new driver.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+from k8s_operator_libs_tpu.health.probes import CheckResult
+
+# Every check `run_host_probe` can emit, in emission order.
+HEALTH_CHECKS_ALL = (
+    "device_enumeration",
+    "mxu_matmul",
+    "hbm_bandwidth",
+    "ici_allreduce",
+    "ici_ring",
+)
+
+
+@dataclass
+class HealthReport:
+    """One host's probe outcome, as published to its node annotation."""
+
+    node_name: str = ""
+    # ControllerRevision hash of the driver DaemonSet the probe ran under;
+    # must match the current DS hash for the report to count.
+    driver_revision: str = ""
+    checks: list[CheckResult] = field(default_factory=list)
+    # Unix seconds when the probe finished.
+    timestamp: float = 0.0
+    # Devices visible to this host's agent (per-host chip count, or the
+    # global count when the agent runs jax.distributed across the slice).
+    visible_devices: int = 0
+    # True when the agent ran jax.distributed over the whole slice, i.e.
+    # `ici_allreduce` spanned every chip of the torus, not one host.
+    slice_wide: bool = False
+
+    @property
+    def healthy(self) -> bool:
+        return bool(self.checks) and all(c.ok for c in self.checks)
+
+    def failed_checks(self) -> list[CheckResult]:
+        return [c for c in self.checks if not c.ok]
+
+    def age_seconds(self, now: float | None = None) -> float:
+        return (now if now is not None else time.time()) - self.timestamp
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "node": self.node_name,
+                "revision": self.driver_revision,
+                "ts": round(self.timestamp, 3),
+                "devices": self.visible_devices,
+                "slice_wide": self.slice_wide,
+                "checks": [c.as_dict() for c in self.checks],
+            },
+            separators=(",", ":"),
+        )
+
+    @staticmethod
+    def from_json(raw: str) -> "HealthReport":
+        """Parse an annotation value; raises ValueError on malformed input
+        (callers treat that as "no report")."""
+        # The annotation is writable by anything with node-patch access;
+        # wrong-typed values must read as "malformed", never crash the
+        # controller's reconcile loop.
+        try:
+            d = json.loads(raw)
+            if not isinstance(d, dict):
+                raise ValueError("not an object")
+            return HealthReport(
+                node_name=str(d.get("node", "")),
+                driver_revision=str(d.get("revision", "")),
+                timestamp=float(d.get("ts", 0.0)),
+                visible_devices=int(d.get("devices", 0)),
+                slice_wide=bool(d.get("slice_wide", False)),
+                checks=[
+                    CheckResult.from_dict(c) for c in d.get("checks", [])
+                ],
+            )
+        except (ValueError, TypeError, AttributeError, KeyError) as e:
+            raise ValueError(f"malformed health report: {e}") from e

@@ -1,0 +1,195 @@
+"""Controller-side slice health probers.
+
+Both classes implement the ``SliceProber`` protocol consumed by
+``upgrade.validation_manager.ValidationManager`` (the TPU redesign of the
+reference's pod-Ready-only check, validation_manager.go:71-136):
+
+- :class:`LocalDeviceProber` runs the JAX probe battery in-process on the
+  devices visible to the controller.  This is the single-host path
+  (BASELINE config 3: controller and the v5e host are one machine) and
+  the bench/dry-run path.
+- :class:`NodeReportProber` is the production multi-host path: each TPU
+  host runs a probe-agent pod (``health.agent``) that publishes a
+  :class:`~k8s_operator_libs_tpu.health.report.HealthReport` node
+  annotation; this prober aggregates the per-host reports into one slice
+  verdict — every host must have a fresh report, probed under the
+  *current* driver revision, with every check passing and the expected
+  chip count visible.  "Validated" therefore means 100 % slice
+  re-formation plus a completed ICI collective (the north star), not
+  merely "a pod is Ready".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.health.probes import run_host_probe
+from k8s_operator_libs_tpu.health.report import HealthReport
+from k8s_operator_libs_tpu.topology.slices import ACCELERATOR_CHIPS_PER_HOST
+from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.validation_manager import ProbeResult
+
+logger = get_logger(__name__)
+
+# A report older than this can't validate: the driver pod restarted more
+# recently than the probe ran, or the agent is wedged.
+DEFAULT_MAX_REPORT_AGE_S = 600.0
+
+
+class LocalDeviceProber:
+    """Run the probe battery in-process on locally-visible devices."""
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        expected_devices: int = 0,
+        matmul_n: int = 2048,
+        hbm_mib: int = 256,
+        allreduce_elems: int = 1 << 20,
+    ) -> None:
+        self.devices = list(devices) if devices is not None else None
+        self.expected_devices = expected_devices
+        self.matmul_n = matmul_n
+        self.hbm_mib = hbm_mib
+        self.allreduce_elems = allreduce_elems
+
+    def probe(self, group: UpgradeGroup) -> ProbeResult:
+        checks = run_host_probe(
+            self.devices,
+            expected_devices=self.expected_devices,
+            matmul_n=self.matmul_n,
+            hbm_mib=self.hbm_mib,
+            allreduce_elems=self.allreduce_elems,
+        )
+        failed = [c for c in checks if not c.ok]
+        if failed:
+            detail = "; ".join(f"{c.name}: {c.detail}" for c in failed)
+            logger.info("group %s local probe failed: %s", group.id, detail)
+            return ProbeResult(False, detail)
+        return ProbeResult(
+            True, f"all {len(checks)} local device checks passed"
+        )
+
+
+def expected_chips_per_host(group: UpgradeGroup) -> int:
+    """Chips each host of this group should enumerate, from its slice
+    accelerator type (0 = unknown, don't enforce)."""
+    if group.slice_info is None:
+        return 0
+    return ACCELERATOR_CHIPS_PER_HOST.get(group.slice_info.accelerator, 0)
+
+
+class NodeReportProber:
+    """Aggregate per-host HealthReport annotations into a slice verdict."""
+
+    def __init__(
+        self,
+        keys: UpgradeKeys,
+        max_report_age_s: float = DEFAULT_MAX_REPORT_AGE_S,
+        # Resolve the driver revision a report must match; wired to
+        # PodManager.get_daemonset_controller_revision_hash by the caller.
+        revision_resolver=None,
+        # Optional floor on reported HBM bandwidth / ICI bus bandwidth;
+        # 0 disables (enumeration+correctness checks still apply).
+        min_hbm_gbps: float = 0.0,
+        min_ici_busbw_gbps: float = 0.0,
+    ) -> None:
+        self.keys = keys
+        self.max_report_age_s = max_report_age_s
+        self.revision_resolver = revision_resolver
+        self.min_hbm_gbps = min_hbm_gbps
+        self.min_ici_busbw_gbps = min_ici_busbw_gbps
+
+    def _required_revision(self, group: UpgradeGroup) -> str:
+        if self.revision_resolver is None:
+            return ""
+        for member in group.members:
+            if member.driver_daemon_set is not None:
+                return self.revision_resolver(member.driver_daemon_set) or ""
+        return ""
+
+    def _check_report(
+        self, report: HealthReport, group: UpgradeGroup, required_rev: str,
+        now: float,
+    ) -> Optional[str]:
+        """Return a rejection reason, or None if the report is acceptable."""
+        if required_rev and report.driver_revision != required_rev:
+            return (
+                f"report is for driver revision "
+                f"{report.driver_revision or '<none>'}, want {required_rev}"
+            )
+        age = report.age_seconds(now)
+        if self.max_report_age_s and age > self.max_report_age_s:
+            return f"report is stale ({age:.0f}s old)"
+        if not report.checks:
+            return "report has no checks"
+        failed = report.failed_checks()
+        if failed:
+            return "; ".join(f"{c.name}: {c.detail}" for c in failed)
+        chips = expected_chips_per_host(group)
+        if report.slice_wide and group.slice_info is not None:
+            # Agent probed the whole torus: it must have seen every chip
+            # of the slice — this IS the 100 % re-formation predicate.
+            # (slice_info.chips is always >0, so this check never silently
+            # disables for unmapped accelerator types.)
+            want = group.slice_info.chips
+            if want and report.visible_devices != want:
+                return (
+                    f"slice-wide probe saw {report.visible_devices} chips, "
+                    f"torus has {want}"
+                )
+        elif chips and report.visible_devices != chips:
+            return (
+                f"host enumerates {report.visible_devices} chips, "
+                f"expected {chips}"
+            )
+        for check in report.checks:
+            if (
+                self.min_hbm_gbps
+                and check.name == "hbm_bandwidth"
+                and check.metrics.get("gbps", 0.0) < self.min_hbm_gbps
+            ):
+                return (
+                    f"HBM bandwidth {check.metrics.get('gbps', 0.0):.1f} "
+                    f"GB/s below floor {self.min_hbm_gbps:.1f}"
+                )
+            if (
+                self.min_ici_busbw_gbps
+                and check.name == "ici_allreduce"
+                and check.metrics.get("busbw_gbps", 0.0)
+                < self.min_ici_busbw_gbps
+            ):
+                return (
+                    f"ICI bus bandwidth "
+                    f"{check.metrics.get('busbw_gbps', 0.0):.1f} GB/s below "
+                    f"floor {self.min_ici_busbw_gbps:.1f}"
+                )
+        return None
+
+    def probe(self, group: UpgradeGroup) -> ProbeResult:
+        key = self.keys.health_report_annotation
+        required_rev = self._required_revision(group)
+        now = time.time()
+        for node in group.nodes:
+            raw = node.annotations.get(key)
+            if not raw:
+                return ProbeResult(
+                    False, f"no health report from node {node.name}"
+                )
+            try:
+                report = HealthReport.from_json(raw)
+            except ValueError as e:
+                return ProbeResult(False, f"node {node.name}: {e}")
+            reason = self._check_report(report, group, required_rev, now)
+            if reason is not None:
+                return ProbeResult(False, f"node {node.name}: {reason}")
+        return ProbeResult(
+            True,
+            f"all {group.size()} host report(s) healthy"
+            + (f" @ revision {required_rev}" if required_rev else ""),
+        )

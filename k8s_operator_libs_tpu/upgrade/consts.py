@@ -125,6 +125,9 @@ UPGRADE_REQUESTED_ANNOTATION_KEY_FMT = "{domain}/{driver}-driver-upgrade-request
 # Slice identity label our topology layer writes/reads when GKE labels are
 # absent (on GKE, cloud.google.com/gke-nodepool + gke-tpu-topology are used).
 SLICE_ID_LABEL_KEY_FMT = "{domain}/{driver}-slice-id"
+# Per-host health report published by the probe agent (health.agent) and
+# consumed by the controller-side NodeReportProber: JSON HealthReport.
+HEALTH_REPORT_ANNOTATION_KEY_FMT = "{domain}/{driver}-health-report"
 # Multi-slice (DCN) group identity: slices in the same group serve one
 # data-parallel JobSet and must never be down simultaneously.
 DCN_GROUP_LABEL_KEY_FMT = "{domain}/{driver}-dcn-group"

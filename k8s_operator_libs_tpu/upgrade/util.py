@@ -130,6 +130,10 @@ class UpgradeKeys:
         return self._fmt(C.DCN_GROUP_LABEL_KEY_FMT)
 
     @property
+    def health_report_annotation(self) -> str:
+        return self._fmt(C.HEALTH_REPORT_ANNOTATION_KEY_FMT)
+
+    @property
     def event_reason(self) -> str:
         # Reference util.go:136-139: "<DRIVER>DriverUpgrade".
         return f"{self.driver_name.upper()}DriverUpgrade"

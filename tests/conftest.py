@@ -24,3 +24,15 @@ def rand_suffix():
     """Per-test random id for object-name isolation
     (reference upgrade_suit_test.go:501-508)."""
     return "".join(random.choices(string.ascii_lowercase, k=5))
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    """The 8 virtual CPU devices JAX tests run on.
+
+    When a TPU plugin is registered in the environment it stays the
+    *default* backend regardless of JAX_PLATFORMS, so every JAX test
+    requests the CPU backend explicitly and passes devices through."""
+    import jax
+
+    return jax.devices("cpu")

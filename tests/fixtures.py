@@ -253,3 +253,19 @@ class ClusterFixture:
 
 def state_of(client: FakeCluster, keys: UpgradeKeys, node_name: str) -> str:
     return client.get_node(node_name).labels.get(keys.state_label, "")
+
+
+def make_node(
+    name: str,
+    labels: Optional[dict] = None,
+    annotations: Optional[dict] = None,
+) -> Node:
+    """A standalone Node object (not registered in any cluster) for tests
+    that exercise pure logic over node metadata."""
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+        )
+    )

@@ -1,0 +1,361 @@
+"""Health backend tests: probe battery on the 8-device virtual CPU mesh,
+report wire format, and both controller-side probers.
+
+The JAX probe code paths are identical on TPU and CPU (only the XLA
+target differs); the virtual mesh is the test substrate mandated by
+BASELINE config 1."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.health import (
+    HealthReport,
+    LocalDeviceProber,
+    NodeReportProber,
+    device_inventory,
+    hbm_bandwidth_probe,
+    ici_allreduce_probe,
+    ici_ring_probe,
+    matmul_probe,
+    run_host_probe,
+)
+from k8s_operator_libs_tpu.health.agent import HealthAgent
+from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.topology.slices import SliceInfo
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+from tests.fixtures import make_node
+
+KEYS = UpgradeKeys()
+
+# Small probe sizes: CPU test tier wants speed, not bandwidth accuracy.
+SMALL = dict(matmul_n=128, hbm_mib=1, allreduce_elems=128)
+
+
+# --- probes ----------------------------------------------------------------
+
+
+def test_device_inventory(cpu_devices):
+    res = device_inventory(cpu_devices)
+    assert res.ok
+    assert res.metrics["devices"] == 8.0
+
+
+def test_device_inventory_wrong_count(cpu_devices):
+    res = device_inventory(cpu_devices, expected_devices=4)
+    assert not res.ok
+    assert "expected 4" in res.detail
+
+
+def test_matmul_probe_exact(cpu_devices):
+    res = matmul_probe(cpu_devices[0], n=128)
+    assert res.ok, res.detail
+    assert res.metrics["tflops"] > 0
+
+
+def test_hbm_bandwidth_probe(cpu_devices):
+    res = hbm_bandwidth_probe(cpu_devices[0], mib=1)
+    assert res.ok, res.detail
+    assert res.metrics["gbps"] > 0
+
+
+def test_ici_allreduce_probe_exact(cpu_devices):
+    res = ici_allreduce_probe(cpu_devices, per_device_elems=128)
+    assert res.ok, res.detail
+    assert res.metrics["devices"] == 8.0
+
+
+def test_ici_allreduce_subset_mesh(cpu_devices):
+    res = ici_allreduce_probe(cpu_devices[:4], per_device_elems=64)
+    assert res.ok, res.detail
+    assert res.metrics["devices"] == 4.0
+
+
+def test_ici_allreduce_single_device_vacuous(cpu_devices):
+    res = ici_allreduce_probe(cpu_devices[:1])
+    assert res.ok
+    assert "no ICI" in res.detail
+
+
+def test_ici_ring_probe(cpu_devices):
+    res = ici_ring_probe(cpu_devices)
+    assert res.ok, res.detail
+    assert "8 ring links" in res.detail
+
+
+def test_run_host_probe_all_checks(cpu_devices):
+    checks = run_host_probe(cpu_devices, **SMALL)
+    names = [c.name for c in checks]
+    assert names == [
+        "device_enumeration",
+        "mxu_matmul",
+        "hbm_bandwidth",
+        "ici_allreduce",
+        "ici_ring",
+    ]
+    assert all(c.ok for c in checks), [c.detail for c in checks]
+
+
+def test_run_host_probe_skip_ici(cpu_devices):
+    checks = run_host_probe(cpu_devices[:1], skip_ici=True, **SMALL)
+    assert [c.name for c in checks] == [
+        "device_enumeration",
+        "mxu_matmul",
+        "hbm_bandwidth",
+    ]
+
+
+# --- report wire format ----------------------------------------------------
+
+
+def test_report_roundtrip(cpu_devices):
+    checks = run_host_probe(cpu_devices, **SMALL)
+    rep = HealthReport(
+        node_name="n0",
+        driver_revision="rev-1",
+        checks=checks,
+        timestamp=time.time(),
+        visible_devices=8,
+        slice_wide=True,
+    )
+    back = HealthReport.from_json(rep.to_json())
+    assert back.healthy
+    assert back.node_name == "n0"
+    assert back.driver_revision == "rev-1"
+    assert back.visible_devices == 8
+    assert back.slice_wide
+    assert [c.name for c in back.checks] == [c.name for c in checks]
+
+
+@pytest.mark.parametrize("raw", ["", "not json", "[1,2]", "{bad"])
+def test_report_malformed(raw):
+    with pytest.raises(ValueError):
+        HealthReport.from_json(raw)
+
+
+def test_report_unhealthy_when_empty():
+    assert not HealthReport(node_name="n").healthy
+
+
+# --- LocalDeviceProber -----------------------------------------------------
+
+
+def _group(nodes, slice_info=None):
+    return UpgradeGroup(
+        id=slice_info.slice_id if slice_info else nodes[0].name,
+        members=[NodeUpgradeState(node=n) for n in nodes],
+        slice_info=slice_info,
+    )
+
+
+def test_local_prober_healthy(cpu_devices):
+    prober = LocalDeviceProber(devices=cpu_devices, **SMALL)
+    res = prober.probe(_group([make_node("n0")]))
+    assert res.healthy, res.detail
+
+
+def test_local_prober_wrong_device_count(cpu_devices):
+    prober = LocalDeviceProber(
+        devices=cpu_devices, expected_devices=16, **SMALL
+    )
+    res = prober.probe(_group([make_node("n0")]))
+    assert not res.healthy
+    assert "expected 16" in res.detail
+
+
+# --- NodeReportProber ------------------------------------------------------
+
+
+def _v5p_slice_info():
+    # 2x2x4 = 16 chips / 4 per host = 4 hosts (v5p).
+    return SliceInfo(
+        slice_id="pool-a",
+        accelerator="tpu-v5p-slice",
+        topology="2x2x4",
+        expected_hosts=4,
+    )
+
+
+def _healthy_report(node_name, revision="rev-1", devices=4, **kw):
+    from k8s_operator_libs_tpu.health.probes import CheckResult
+
+    return HealthReport(
+        node_name=node_name,
+        driver_revision=revision,
+        checks=[
+            CheckResult("device_enumeration", True, 1.0),
+            CheckResult("mxu_matmul", True, 1.0),
+            CheckResult("hbm_bandwidth", True, 1.0, metrics={"gbps": 100.0}),
+            CheckResult(
+                "ici_allreduce", True, 1.0, metrics={"busbw_gbps": 50.0}
+            ),
+            CheckResult("ici_ring", True, 1.0),
+        ],
+        timestamp=kw.pop("timestamp", time.time()),
+        visible_devices=devices,
+        **kw,
+    )
+
+
+def _slice_nodes_with_reports(reports):
+    nodes = []
+    for i, rep in enumerate(reports):
+        node = make_node(
+            f"host-{i}",
+            labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                "cloud.google.com/gke-tpu-topology": "2x2x4",
+                "cloud.google.com/gke-nodepool": "pool-a",
+            },
+        )
+        if rep is not None:
+            node.annotations[KEYS.health_report_annotation] = rep.to_json()
+        nodes.append(node)
+    return nodes
+
+
+def test_node_report_prober_all_healthy():
+    reports = [_healthy_report(f"host-{i}") for i in range(4)]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    prober = NodeReportProber(KEYS, revision_resolver=lambda ds: "rev-1")
+    # group members have no DS → resolver yields "" → revision not enforced
+    res = prober.probe(group)
+    assert res.healthy, res.detail
+
+
+def test_node_report_prober_missing_report():
+    reports = [_healthy_report(f"host-{i}") for i in range(3)] + [None]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(KEYS).probe(group)
+    assert not res.healthy
+    assert "no health report from node host-3" in res.detail
+
+
+def test_node_report_prober_stale_report():
+    reports = [
+        _healthy_report(f"host-{i}", timestamp=time.time() - 10_000)
+        for i in range(4)
+    ]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(KEYS, max_report_age_s=60).probe(group)
+    assert not res.healthy
+    assert "stale" in res.detail
+
+
+def test_node_report_prober_wrong_revision():
+    class DS:
+        pass
+
+    reports = [_healthy_report(f"host-{i}", revision="old") for i in range(4)]
+    nodes = _slice_nodes_with_reports(reports)
+    group = UpgradeGroup(
+        id="pool-a",
+        members=[
+            NodeUpgradeState(node=n, driver_daemon_set=DS()) for n in nodes
+        ],
+        slice_info=_v5p_slice_info(),
+    )
+    prober = NodeReportProber(KEYS, revision_resolver=lambda ds: "new")
+    res = prober.probe(group)
+    assert not res.healthy
+    assert "revision old, want new" in res.detail
+
+
+def test_node_report_prober_wrong_chip_count():
+    # v5p host must enumerate 4 chips; report says 3 → chip lost on reboot.
+    reports = [
+        _healthy_report(f"host-{i}", devices=3 if i == 2 else 4)
+        for i in range(4)
+    ]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(KEYS).probe(group)
+    assert not res.healthy
+    assert "host-2" in res.detail and "expected 4" in res.detail
+
+
+def test_node_report_prober_slice_wide_reformation():
+    # slice_wide agent must see the whole 16-chip torus.
+    ok = [
+        _healthy_report(f"host-{i}", devices=16, slice_wide=True)
+        for i in range(4)
+    ]
+    group = _group(_slice_nodes_with_reports(ok), _v5p_slice_info())
+    assert NodeReportProber(KEYS).probe(group).healthy
+
+    partial = [
+        _healthy_report(f"host-{i}", devices=12, slice_wide=True)
+        for i in range(4)
+    ]
+    group = _group(_slice_nodes_with_reports(partial), _v5p_slice_info())
+    res = NodeReportProber(KEYS).probe(group)
+    assert not res.healthy
+    assert "torus has 16" in res.detail
+
+
+def test_node_report_prober_failed_check_attributed():
+    from k8s_operator_libs_tpu.health.probes import CheckResult
+
+    bad = _healthy_report("host-1")
+    bad.checks[3] = CheckResult(
+        "ici_allreduce", False, 5.0, "psum mismatch: expected 10.0"
+    )
+    reports = [_healthy_report("host-0"), bad] + [
+        _healthy_report(f"host-{i}") for i in (2, 3)
+    ]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(KEYS).probe(group)
+    assert not res.healthy
+    assert "host-1" in res.detail and "ici_allreduce" in res.detail
+
+
+def test_node_report_prober_bandwidth_floor():
+    reports = [_healthy_report(f"host-{i}") for i in range(4)]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(KEYS, min_hbm_gbps=500.0).probe(group)
+    assert not res.healthy
+    assert "below floor" in res.detail
+    res = NodeReportProber(KEYS, min_ici_busbw_gbps=500.0).probe(group)
+    assert not res.healthy
+    assert "below floor" in res.detail
+
+
+# --- agent end-to-end on the fake cluster ----------------------------------
+
+
+def test_agent_publishes_report_and_prober_reads_it(cpu_devices):
+    cluster = FakeCluster()
+    node = make_node(
+        "host-0",
+        labels={
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-device",
+            "cloud.google.com/gke-tpu-topology": "2x4",
+            "cloud.google.com/gke-nodepool": "pool-s",
+        },
+    )
+    cluster.create_node(node)
+    agent = HealthAgent(
+        cluster,
+        "host-0",
+        KEYS,
+        driver_revision="rev-9",
+        devices=cpu_devices,
+        slice_wide=False,
+        **SMALL,
+    )
+    report = agent.run_once()
+    assert report.healthy
+
+    fresh = cluster.get_node("host-0", cached=False)
+    info = SliceInfo(
+        slice_id="pool-s",
+        accelerator="tpu-v5-lite-device",
+        topology="2x4",
+        expected_hosts=1,
+    )
+    group = _group([fresh], info)
+    prober = NodeReportProber(KEYS, revision_resolver=None)
+    res = prober.probe(group)
+    assert res.healthy, res.detail
