@@ -52,6 +52,13 @@ class ConflictError(RuntimeError):
     pass
 
 
+class EvictionBlockedError(RuntimeError):
+    """Eviction rejected by a PodDisruptionBudget (HTTP 429).
+
+    kubectl drain retries these until the drain timeout; DrainHelper does
+    the same."""
+
+
 _HISTORY_CAP = 64
 
 
@@ -106,6 +113,9 @@ class FakeCluster:
         # verb -> count; exposed for bench round-trip accounting
         self.stats: Counter = Counter()
         self._pod_deleted_hooks: list[Callable[[Pod], None]] = []
+        # (namespace, name) pairs whose eviction a PodDisruptionBudget
+        # currently blocks (429 in the real API) — test/bench knob.
+        self._eviction_blocked: set[tuple[str, str]] = set()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -274,9 +284,26 @@ class FakeCluster:
         self._call("delete_pod")
         self._delete_pod_impl(namespace, name)
 
+    def set_eviction_blocked(
+        self, namespace: str, name: str, blocked: bool = True
+    ) -> None:
+        """Model a PodDisruptionBudget blocking (or releasing) a pod's
+        eviction."""
+        with self._lock:
+            key = (namespace, name)
+            if blocked:
+                self._eviction_blocked.add(key)
+            else:
+                self._eviction_blocked.discard(key)
+
     def evict_pod(self, namespace: str, name: str) -> None:
         """Eviction-API analogue (what drain actually calls)."""
         self._call("evict_pod")
+        with self._lock:
+            if (namespace, name) in self._eviction_blocked:
+                raise EvictionBlockedError(
+                    f"Cannot evict pod {namespace}/{name}: disruption budget"
+                )
         self._delete_pod_impl(namespace, name)
 
     def _delete_pod_impl(self, namespace: str, name: str) -> None:

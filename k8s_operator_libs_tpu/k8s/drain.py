@@ -20,7 +20,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from k8s_operator_libs_tpu.k8s.client import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s.client import (
+    EvictionBlockedError,
+    FakeCluster,
+    NotFoundError,
+)
 from k8s_operator_libs_tpu.k8s.objects import Node, Pod
 from k8s_operator_libs_tpu.k8s.selectors import matches_selector
 
@@ -126,32 +130,51 @@ class DrainHelper:
     # -- eviction ----------------------------------------------------------
 
     def delete_or_evict_pods(self, pods: list[Pod]) -> None:
-        """Evict pods and wait until they are gone (or timeout)."""
+        """Evict pods and wait until they are gone (or timeout).
+
+        An eviction rejected by a PodDisruptionBudget (HTTP 429 →
+        :class:`EvictionBlockedError`) is retried until the drain timeout,
+        matching kubectl drain's behavior — a temporarily-blocked PDB must
+        stall the drain, not crash the reconcile."""
         deadline = (
             time.monotonic() + self.timeout_s if self.timeout_s > 0 else None
         )
-        for pod in pods:
-            try:
-                self.client.evict_pod(pod.namespace, pod.name)
-            except NotFoundError:
-                continue  # already gone
-            if self.on_pod_deleted is not None:
-                self.on_pod_deleted(pod, True)
-        # Wait for deletion to complete (kubectl waits for pods to vanish).
-        pending = {(p.namespace, p.name) for p in pods}
-        while pending:
+        by_key = {(p.namespace, p.name): p for p in pods}
+        to_evict = set(by_key)
+        pending = set(by_key)
+        while True:
+            for key in sorted(to_evict):
+                ns, name = key
+                try:
+                    self.client.evict_pod(ns, name)
+                except NotFoundError:
+                    to_evict.discard(key)  # already gone
+                    continue
+                except EvictionBlockedError:
+                    continue  # PDB: retry next round
+                to_evict.discard(key)
+                if self.on_pod_deleted is not None:
+                    self.on_pod_deleted(by_key[key], True)
+            # Wait for evicted pods to vanish (kubectl waits for deletion).
             gone = set()
-            for ns, name in pending:
+            for ns, name in pending - to_evict:
                 try:
                     self.client.get_pod(ns, name)
                 except NotFoundError:
                     gone.add((ns, name))
             pending -= gone
             if not pending:
-                break
+                return
             if deadline is not None and time.monotonic() > deadline:
+                blocked = sorted(to_evict)
+                waiting = sorted(pending - to_evict)
+                detail = []
+                if blocked:
+                    detail.append(f"evictions blocked by PDB: {blocked}")
+                if waiting:
+                    detail.append(f"pods not yet deleted: {waiting}")
                 raise DrainError(
-                    f"timed out waiting for pods to be deleted: {sorted(pending)}"
+                    "timed out draining: " + "; ".join(detail)
                 )
             time.sleep(self.poll_interval_s)
 

@@ -1,0 +1,557 @@
+"""Real-cluster Kubernetes client over the REST API.
+
+The reference links client-go (`go.mod:7-15`); this is the stdlib-only
+equivalent for the narrow API slice the engine uses (SURVEY.md §3): node
+get/list/patch, pod list/get/delete/evict, DaemonSet + ControllerRevision
+list.  It is verb-for-verb duck-type-compatible with
+:class:`~k8s_operator_libs_tpu.k8s.client.FakeCluster`, so every layer
+above (state manager, drain helper, probers, agents) runs unchanged
+against a real apiserver — the FakeCluster is the envtest tier, this is
+the kind/real-cluster tier (BASELINE configs 2-5).
+
+Auth: in-cluster service account (token + CA from the pod filesystem) or
+kubeconfig (current-context; token, client-cert, or insecure modes).  No
+third-party dependencies: urllib + ssl + yaml (kubeconfig parsing).
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import datetime
+import json
+import os
+import ssl
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import Counter
+from dataclasses import dataclass
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import (
+    ConflictError,
+    EvictionBlockedError,
+    NotFoundError,
+)
+from k8s_operator_libs_tpu.k8s.objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    LabelSelectorSpec,
+    Node,
+    NodeCondition,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    Volume,
+)
+
+logger = get_logger(__name__)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+JSON = "application/json"
+MERGE_PATCH = "application/merge-patch+json"
+STRATEGIC_MERGE_PATCH = "application/strategic-merge-patch+json"
+
+
+# --- configuration ----------------------------------------------------------
+
+
+@dataclass
+class KubeConfig:
+    """Connection parameters for one apiserver."""
+
+    host: str  # e.g. https://10.0.0.1:443
+    token: str = ""
+    # When set, the token is re-read from this file (bound service-account
+    # tokens rotate; client-go re-reads them the same way).
+    token_path: str = ""
+    ca_cert_path: str = ""
+    client_cert_path: str = ""
+    client_key_path: str = ""
+    insecure_skip_tls_verify: bool = False
+
+    @staticmethod
+    def in_cluster() -> "KubeConfig":
+        """Service-account config from the pod filesystem (client-go's
+        rest.InClusterConfig analogue)."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+        if not host or not os.path.exists(token_path):
+            raise RuntimeError(
+                "not running in a cluster (no KUBERNETES_SERVICE_HOST / "
+                "service-account token)"
+            )
+        with open(token_path) as f:
+            token = f.read().strip()
+        ca = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+        return KubeConfig(
+            host=f"https://{host}:{port}",
+            token=token,
+            token_path=token_path,
+            ca_cert_path=ca if os.path.exists(ca) else "",
+        )
+
+    @staticmethod
+    def from_kubeconfig(
+        path: str = "", context: str = ""
+    ) -> "KubeConfig":
+        """Parse a kubeconfig file (current-context unless overridden).
+
+        Supports token, client-certificate(-data), client-key(-data),
+        certificate-authority(-data) and insecure-skip-tls-verify.
+        exec / auth-provider credential plugins (e.g. the GKE gcloud
+        plugin) are rejected at parse time with a clear error instead of
+        failing later with opaque 401s."""
+        import yaml
+
+        if not path:
+            # KUBECONFIG may be a path LIST (kubectl merges them; we take
+            # the first existing file).
+            env_paths = [
+                p
+                for p in os.environ.get("KUBECONFIG", "").split(os.pathsep)
+                if p
+            ]
+            for p in env_paths:
+                if os.path.exists(os.path.expanduser(p)):
+                    path = os.path.expanduser(p)
+                    break
+            else:
+                path = os.path.expanduser("~/.kube/config")
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = _named(cfg.get("contexts", []), ctx_name)
+        if ctx is None:
+            raise RuntimeError(f"kubeconfig context {ctx_name!r} not found")
+        cluster = _named(cfg.get("clusters", []), ctx["context"]["cluster"])
+        user = _named(cfg.get("users", []), ctx["context"]["user"])
+        if cluster is None or user is None:
+            raise RuntimeError("kubeconfig cluster/user not found")
+        cl, us = cluster["cluster"], user.get("user", {})
+        if "exec" in us or "auth-provider" in us:
+            raise RuntimeError(
+                "kubeconfig uses an exec/auth-provider credential plugin, "
+                "which this stdlib client does not support; use a "
+                "service-account token kubeconfig, client certificates, "
+                "or run in-cluster"
+            )
+
+        def materialize(data_key: str, path_key: str, suffix: str) -> str:
+            """Inline *-data wins over a file path; write it to a temp file
+            (ssl wants paths), cleaned up at process exit."""
+            data = us.get(data_key) or cl.get(data_key)
+            if data:
+                f = tempfile.NamedTemporaryFile(
+                    suffix=suffix, delete=False, mode="wb"
+                )
+                f.write(base64.b64decode(data))
+                f.close()
+                atexit.register(_unlink_quiet, f.name)
+                return f.name
+            return us.get(path_key) or cl.get(path_key) or ""
+
+        return KubeConfig(
+            host=cl["server"],
+            token=us.get("token", ""),
+            ca_cert_path=materialize(
+                "certificate-authority-data", "certificate-authority", ".crt"
+            ),
+            client_cert_path=materialize(
+                "client-certificate-data", "client-certificate", ".crt"
+            ),
+            client_key_path=materialize(
+                "client-key-data", "client-key", ".key"
+            ),
+            insecure_skip_tls_verify=bool(
+                cl.get("insecure-skip-tls-verify", False)
+            ),
+        )
+
+
+def _named(items: list, name: str) -> Optional[dict]:
+    for item in items:
+        if item.get("name") == name:
+            return item
+    return None
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+# --- JSON <-> typed object model --------------------------------------------
+
+
+def _parse_time(value) -> Optional[float]:
+    if not value:
+        return None
+    try:
+        return datetime.datetime.fromisoformat(
+            str(value).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return None
+
+
+def _meta_from_json(m: dict) -> ObjectMeta:
+    meta = ObjectMeta(
+        name=m.get("name", ""),
+        namespace=m.get("namespace", ""),
+        uid=m.get("uid", ""),
+        labels=dict(m.get("labels") or {}),
+        annotations=dict(m.get("annotations") or {}),
+        owner_references=[
+            OwnerReference(
+                name=o.get("name", ""),
+                uid=o.get("uid", ""),
+                kind=o.get("kind", ""),
+                controller=bool(o.get("controller", False)),
+            )
+            for o in (m.get("ownerReferences") or [])
+        ],
+        deletion_timestamp=_parse_time(m.get("deletionTimestamp")),
+    )
+    ts = _parse_time(m.get("creationTimestamp"))
+    if ts is not None:
+        meta.creation_timestamp = ts
+    try:
+        meta.resource_version = int(m.get("resourceVersion", "0"))
+    except (TypeError, ValueError):
+        meta.resource_version = 0
+    return meta
+
+
+def node_from_json(d: dict) -> Node:
+    node = Node(metadata=_meta_from_json(d.get("metadata") or {}))
+    node.spec.unschedulable = bool(
+        (d.get("spec") or {}).get("unschedulable", False)
+    )
+    conditions = (d.get("status") or {}).get("conditions") or []
+    if conditions:
+        node.status.conditions = [
+            NodeCondition(c.get("type", ""), c.get("status", "Unknown"))
+            for c in conditions
+        ]
+    return node
+
+
+def _container_statuses(raw) -> list[ContainerStatus]:
+    return [
+        ContainerStatus(
+            name=c.get("name", ""),
+            ready=bool(c.get("ready", False)),
+            restart_count=int(c.get("restartCount", 0)),
+        )
+        for c in (raw or [])
+    ]
+
+
+def pod_from_json(d: dict) -> Pod:
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    return Pod(
+        metadata=_meta_from_json(d.get("metadata") or {}),
+        spec=PodSpec(
+            node_name=spec.get("nodeName", ""),
+            volumes=[
+                Volume(name=v.get("name", ""), empty_dir="emptyDir" in v)
+                for v in (spec.get("volumes") or [])
+            ],
+        ),
+        status=PodStatus(
+            phase=status.get("phase", ""),
+            container_statuses=_container_statuses(
+                status.get("containerStatuses")
+            ),
+            init_container_statuses=_container_statuses(
+                status.get("initContainerStatuses")
+            ),
+        ),
+    )
+
+
+def daemon_set_from_json(d: dict) -> DaemonSet:
+    spec = d.get("spec") or {}
+    selector = (spec.get("selector") or {}).get("matchLabels") or {}
+    template_labels = (
+        ((spec.get("template") or {}).get("metadata") or {}).get("labels")
+        or {}
+    )
+    return DaemonSet(
+        metadata=_meta_from_json(d.get("metadata") or {}),
+        spec=DaemonSetSpec(
+            selector=LabelSelectorSpec(dict(selector)),
+            template=PodTemplateSpec(labels=dict(template_labels)),
+        ),
+        status=DaemonSetStatus(
+            desired_number_scheduled=int(
+                (d.get("status") or {}).get("desiredNumberScheduled", 0)
+            )
+        ),
+    )
+
+
+def controller_revision_from_json(d: dict) -> ControllerRevision:
+    return ControllerRevision(
+        metadata=_meta_from_json(d.get("metadata") or {}),
+        revision=int(d.get("revision", 0)),
+    )
+
+
+def _label_selector(
+    label_selector: str = "", match_labels: Optional[dict[str, str]] = None
+) -> str:
+    parts = [label_selector] if label_selector else []
+    parts.extend(f"{k}={v}" for k, v in (match_labels or {}).items())
+    return ",".join(parts)
+
+
+# --- the client -------------------------------------------------------------
+
+
+class RestClient:
+    """Duck-type-compatible with FakeCluster for every verb the engine,
+    drain helper, probers and agents use."""
+
+    # Bound SA tokens rotate; re-read the token file at most this often.
+    TOKEN_REFRESH_S = 60.0
+
+    def __init__(self, config: KubeConfig, timeout_s: float = 30.0) -> None:
+        self.config = config
+        self.timeout_s = timeout_s
+        self.stats: Counter = Counter()
+        self._token = config.token
+        self._token_read_at = time.monotonic()
+        ctx = ssl.create_default_context()
+        if config.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif config.ca_cert_path:
+            ctx = ssl.create_default_context(cafile=config.ca_cert_path)
+        if config.client_cert_path and config.client_key_path:
+            ctx.load_cert_chain(
+                config.client_cert_path, config.client_key_path
+            )
+        self._ssl = ctx
+
+    # -- transport ---------------------------------------------------------
+
+    def _current_token(self) -> str:
+        """The bearer token, re-read periodically when file-backed (bound
+        service-account tokens rotate; a long-running controller must pick
+        up the new one or every call 401s after the TTL)."""
+        if (
+            self.config.token_path
+            and time.monotonic() - self._token_read_at > self.TOKEN_REFRESH_S
+        ):
+            try:
+                with open(self.config.token_path) as f:
+                    self._token = f.read().strip()
+            except OSError:
+                logger.warning(
+                    "could not re-read token file %s", self.config.token_path
+                )
+            self._token_read_at = time.monotonic()
+        return self._token
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[dict] = None,
+        body: Optional[dict] = None,
+        content_type: str = JSON,
+    ) -> dict:
+        url = self.config.host + path
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v}
+            )
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", JSON)
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        token = self._current_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        self.stats[f"{method} {path.split('?')[0]}"] += 1
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout_s, context=self._ssl
+            ) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:512]
+            if e.code == 404:
+                raise NotFoundError(f"{method} {path}: {detail}") from e
+            if e.code == 409:
+                raise ConflictError(f"{method} {path}: {detail}") from e
+            if e.code == 429:
+                # PodDisruptionBudget rejecting an eviction; DrainHelper
+                # retries these until its timeout (kubectl semantics).
+                raise EvictionBlockedError(
+                    f"{method} {path}: {detail}"
+                ) from e
+            raise RuntimeError(
+                f"apiserver {method} {path} -> {e.code}: {detail}"
+            ) from e
+        return json.loads(payload) if payload else {}
+
+    # -- nodes -------------------------------------------------------------
+
+    def get_node(self, name: str, cached: bool = True) -> Node:
+        # A REST read is always a quorum read; `cached` exists for
+        # interface parity with FakeCluster (controller-runtime's cache
+        # does not apply here, but the write-then-poll loop in
+        # NodeUpgradeStateProvider is still correct — it just converges
+        # on the first poll).
+        return node_from_json(self._request("GET", f"/api/v1/nodes/{name}"))
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        out = self._request(
+            "GET", "/api/v1/nodes", {"labelSelector": label_selector}
+        )
+        return [node_from_json(i) for i in out.get("items", [])]
+
+    def patch_node_labels(
+        self, name: str, patch: dict[str, Optional[str]]
+    ) -> Node:
+        return node_from_json(
+            self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                body={"metadata": {"labels": patch}},
+                content_type=STRATEGIC_MERGE_PATCH,
+            )
+        )
+
+    def patch_node_annotations(
+        self, name: str, patch: dict[str, Optional[str]]
+    ) -> Node:
+        return node_from_json(
+            self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                body={"metadata": {"annotations": patch}},
+                content_type=MERGE_PATCH,
+            )
+        )
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        return node_from_json(
+            self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                body={"spec": {"unschedulable": unschedulable}},
+                content_type=STRATEGIC_MERGE_PATCH,
+            )
+        )
+
+    # -- pods --------------------------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return pod_from_json(
+            self._request(
+                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+            )
+        )
+
+    def list_pods(
+        self,
+        namespace: str = "",
+        label_selector: str = "",
+        node_name: Optional[str] = None,
+        match_labels: Optional[dict[str, str]] = None,
+    ) -> list[Pod]:
+        path = (
+            f"/api/v1/namespaces/{namespace}/pods"
+            if namespace
+            else "/api/v1/pods"
+        )
+        query = {
+            "labelSelector": _label_selector(label_selector, match_labels)
+        }
+        if node_name is not None:
+            query["fieldSelector"] = f"spec.nodeName={node_name}"
+        out = self._request("GET", path, query)
+        return [pod_from_json(i) for i in out.get("items", [])]
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request(
+            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}"
+        )
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """policy/v1 Eviction — what kubectl drain actually calls
+        (reference drain_manager.go via k8s.io/kubectl/pkg/drain)."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+            body={
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            },
+        )
+
+    # -- daemonsets + controller revisions -----------------------------------
+
+    def get_daemon_set(self, namespace: str, name: str) -> DaemonSet:
+        return daemon_set_from_json(
+            self._request(
+                "GET",
+                f"/apis/apps/v1/namespaces/{namespace}/daemonsets/{name}",
+            )
+        )
+
+    def list_daemon_sets(
+        self, namespace: str = "", match_labels: Optional[dict] = None
+    ) -> list[DaemonSet]:
+        path = (
+            f"/apis/apps/v1/namespaces/{namespace}/daemonsets"
+            if namespace
+            else "/apis/apps/v1/daemonsets"
+        )
+        out = self._request(
+            "GET", path, {"labelSelector": _label_selector("", match_labels)}
+        )
+        return [daemon_set_from_json(i) for i in out.get("items", [])]
+
+    def list_controller_revisions(
+        self, namespace: str = "", label_selector: str = ""
+    ) -> list[ControllerRevision]:
+        path = (
+            f"/apis/apps/v1/namespaces/{namespace}/controllerrevisions"
+            if namespace
+            else "/apis/apps/v1/controllerrevisions"
+        )
+        out = self._request("GET", path, {"labelSelector": label_selector})
+        return [
+            controller_revision_from_json(i) for i in out.get("items", [])
+        ]
+
+
+def get_default_client(timeout_s: float = 30.0) -> RestClient:
+    """In-cluster config when available, else kubeconfig."""
+    try:
+        cfg = KubeConfig.in_cluster()
+    except RuntimeError:
+        cfg = KubeConfig.from_kubeconfig()
+    return RestClient(cfg, timeout_s=timeout_s)

@@ -1,0 +1,50 @@
+"""Drain vs PodDisruptionBudget: blocked evictions retry until the drain
+timeout (kubectl semantics), then fail with an attributable error."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s import DrainError, FakeCluster
+from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+from tests.fixtures import ClusterFixture
+
+
+@pytest.fixture()
+def cluster():
+    return FakeCluster()
+
+
+def test_blocked_eviction_retries_until_released(cluster):
+    fx = ClusterFixture(cluster)
+    node = fx.node("n1")
+    pod = fx.workload_pod(node, name="protected")
+    cluster.set_eviction_blocked(pod.namespace, pod.name)
+
+    helper = DrainHelper(cluster, timeout_s=5.0, poll_interval_s=0.01)
+
+    def release():
+        time.sleep(0.1)
+        cluster.set_eviction_blocked(pod.namespace, pod.name, False)
+
+    t = threading.Thread(target=release)
+    t.start()
+    helper.run_node_drain("n1")  # must not raise
+    t.join()
+    assert cluster.list_pods(node_name="n1") == []
+
+
+def test_blocked_eviction_times_out_with_pdb_detail(cluster):
+    fx = ClusterFixture(cluster)
+    node = fx.node("n1")
+    pod = fx.workload_pod(node, name="protected")
+    cluster.set_eviction_blocked(pod.namespace, pod.name)
+
+    helper = DrainHelper(cluster, timeout_s=0.1, poll_interval_s=0.01)
+    with pytest.raises(DrainError, match="blocked by PDB"):
+        helper.run_node_drain("n1")
+    # Pod survives: eviction never succeeded.
+    assert len(cluster.list_pods(node_name="n1")) == 1

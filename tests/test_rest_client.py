@@ -1,0 +1,361 @@
+"""RestClient tests against an in-process stub apiserver.
+
+The stub speaks just enough of the Kubernetes REST API (JSON bodies,
+patch content types, selectors as query params, the Eviction subresource)
+to verify the client's wire behavior — the analogue of the reference
+testing its client layer against envtest's real apiserver."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s.client import NotFoundError
+from k8s_operator_libs_tpu.k8s.rest import (
+    KubeConfig,
+    RestClient,
+    node_from_json,
+    pod_from_json,
+)
+
+NODE_JSON = {
+    "metadata": {
+        "name": "host-0",
+        "uid": "u-1",
+        "resourceVersion": "42",
+        "labels": {"cloud.google.com/gke-nodepool": "pool-a"},
+        "annotations": {"a": "b"},
+        "creationTimestamp": "2026-01-01T00:00:00Z",
+    },
+    "spec": {"unschedulable": True},
+    "status": {"conditions": [{"type": "Ready", "status": "False"}]},
+}
+
+POD_JSON = {
+    "metadata": {
+        "name": "driver-1",
+        "namespace": "kube-system",
+        "uid": "p-1",
+        "labels": {"app": "libtpu", "controller-revision-hash": "h1"},
+        "ownerReferences": [
+            {"name": "libtpu", "uid": "ds-1", "kind": "DaemonSet",
+             "controller": True}
+        ],
+        "deletionTimestamp": "2026-01-02T00:00:00Z",
+    },
+    "spec": {
+        "nodeName": "host-0",
+        "volumes": [{"name": "scratch", "emptyDir": {}}],
+    },
+    "status": {
+        "phase": "Running",
+        "containerStatuses": [
+            {"name": "driver", "ready": True, "restartCount": 3}
+        ],
+    },
+}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    requests: list = []
+
+    def _respond(self, code: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _record(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode() if length else ""
+        _Handler.requests.append(
+            {
+                "method": self.command,
+                "path": self.path,
+                "content_type": self.headers.get("Content-Type", ""),
+                "auth": self.headers.get("Authorization", ""),
+                "body": json.loads(body) if body else None,
+            }
+        )
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        self._record()
+        if self.path.startswith("/api/v1/nodes/missing"):
+            self._respond(404, {"reason": "NotFound"})
+        elif self.path.startswith("/api/v1/nodes/host-0"):
+            self._respond(200, NODE_JSON)
+        elif self.path.startswith("/api/v1/nodes"):
+            self._respond(200, {"items": [NODE_JSON]})
+        elif "/pods" in self.path:
+            self._respond(200, {"items": [POD_JSON]})
+        elif "/daemonsets" in self.path:
+            self._respond(
+                200,
+                {
+                    "items": [
+                        {
+                            "metadata": {"name": "libtpu",
+                                         "namespace": "kube-system",
+                                         "uid": "ds-1"},
+                            "spec": {
+                                "selector": {"matchLabels": {"app": "libtpu"}},
+                                "template": {
+                                    "metadata": {"labels": {"app": "libtpu"}}
+                                },
+                            },
+                            "status": {"desiredNumberScheduled": 4},
+                        }
+                    ]
+                },
+            )
+        elif "/controllerrevisions" in self.path:
+            self._respond(
+                200,
+                {
+                    "items": [
+                        {
+                            "metadata": {"name": "libtpu-h1",
+                                         "namespace": "kube-system",
+                                         "labels": {"app": "libtpu"}},
+                            "revision": 7,
+                        }
+                    ]
+                },
+            )
+        else:
+            self._respond(404, {})
+
+    def do_PATCH(self):  # noqa: N802
+        self._record()
+        self._respond(200, NODE_JSON)
+
+    def do_DELETE(self):  # noqa: N802
+        self._record()
+        self._respond(200, {})
+
+    def do_POST(self):  # noqa: N802
+        self._record()
+        self._respond(201, {})
+
+    def log_message(self, *args):  # silence
+        pass
+
+
+@pytest.fixture()
+def stub_client():
+    _Handler.requests = []
+    server = HTTPServer(("127.0.0.1", 0), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    client = RestClient(
+        KubeConfig(host=f"http://127.0.0.1:{server.server_port}",
+                   token="tok-1")
+    )
+    yield client
+    server.shutdown()
+
+
+def last_request():
+    return _Handler.requests[-1]
+
+
+def test_get_node_parses_fields(stub_client):
+    node = stub_client.get_node("host-0")
+    assert node.name == "host-0"
+    assert node.spec.unschedulable
+    assert not node.is_ready()
+    assert node.labels["cloud.google.com/gke-nodepool"] == "pool-a"
+    assert node.metadata.resource_version == 42
+    assert last_request()["auth"] == "Bearer tok-1"
+
+
+def test_get_node_not_found(stub_client):
+    with pytest.raises(NotFoundError):
+        stub_client.get_node("missing")
+
+
+def test_patch_node_labels_strategic_merge(stub_client):
+    stub_client.patch_node_labels("host-0", {"k": "v", "gone": None})
+    req = last_request()
+    assert req["method"] == "PATCH"
+    assert req["content_type"] == "application/strategic-merge-patch+json"
+    assert req["body"] == {"metadata": {"labels": {"k": "v", "gone": None}}}
+
+
+def test_patch_node_annotations_merge_patch(stub_client):
+    stub_client.patch_node_annotations("host-0", {"a": None})
+    req = last_request()
+    assert req["content_type"] == "application/merge-patch+json"
+    assert req["body"] == {"metadata": {"annotations": {"a": None}}}
+
+
+def test_set_node_unschedulable(stub_client):
+    stub_client.set_node_unschedulable("host-0", True)
+    assert last_request()["body"] == {"spec": {"unschedulable": True}}
+
+
+def test_list_pods_selectors(stub_client):
+    pods = stub_client.list_pods(
+        namespace="kube-system",
+        match_labels={"app": "libtpu"},
+        node_name="host-0",
+    )
+    assert len(pods) == 1
+    pod = pods[0]
+    assert pod.spec.node_name == "host-0"
+    assert pod.is_terminating()
+    assert pod.uses_empty_dir()
+    assert pod.status.container_statuses[0].restart_count == 3
+    path = last_request()["path"]
+    assert "/namespaces/kube-system/pods" in path
+    assert "labelSelector=app%3Dlibtpu" in path
+    assert "fieldSelector=spec.nodeName%3Dhost-0" in path
+
+
+def test_evict_pod_posts_eviction(stub_client):
+    stub_client.evict_pod("kube-system", "driver-1")
+    req = last_request()
+    assert req["method"] == "POST"
+    assert req["path"].endswith("/pods/driver-1/eviction")
+    assert req["body"]["kind"] == "Eviction"
+
+
+def test_delete_pod(stub_client):
+    stub_client.delete_pod("kube-system", "driver-1")
+    assert last_request()["method"] == "DELETE"
+
+
+def test_list_daemon_sets_and_revisions(stub_client):
+    dss = stub_client.list_daemon_sets(
+        "kube-system", match_labels={"app": "libtpu"}
+    )
+    assert dss[0].spec.selector.match_labels == {"app": "libtpu"}
+    assert dss[0].status.desired_number_scheduled == 4
+    revs = stub_client.list_controller_revisions(
+        "kube-system", "app=libtpu"
+    )
+    assert revs[0].revision == 7
+    assert revs[0].metadata.name == "libtpu-h1"
+
+
+def test_build_state_guard_over_rest(stub_client):
+    """The state manager's BuildState path runs verbatim over REST (the
+    duck-type compatibility the module promises): the stub returns one DS
+    wanting 4 pods but only 1 scheduled pod, and BuildState rejects the
+    incoherent snapshot exactly like the reference
+    (upgrade_state.go:243-246)."""
+    from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+        BuildStateError,
+        ClusterUpgradeStateManager,
+    )
+
+    mgr = ClusterUpgradeStateManager(stub_client)
+    with pytest.raises(BuildStateError):
+        mgr.build_state("kube-system", {"app": "libtpu"})
+
+
+# --- kubeconfig parsing -----------------------------------------------------
+
+
+def test_kubeconfig_token_auth(tmp_path):
+    cfg_file = tmp_path / "config"
+    cfg_file.write_text(
+        json.dumps(
+            {
+                "current-context": "ctx",
+                "contexts": [
+                    {"name": "ctx",
+                     "context": {"cluster": "c1", "user": "u1"}}
+                ],
+                "clusters": [
+                    {"name": "c1",
+                     "cluster": {"server": "https://1.2.3.4:6443",
+                                 "insecure-skip-tls-verify": True}}
+                ],
+                "users": [{"name": "u1", "user": {"token": "secret"}}],
+            }
+        )
+    )
+    cfg = KubeConfig.from_kubeconfig(str(cfg_file))
+    assert cfg.host == "https://1.2.3.4:6443"
+    assert cfg.token == "secret"
+    assert cfg.insecure_skip_tls_verify
+
+
+def test_kubeconfig_rejects_exec_plugin(tmp_path):
+    cfg_file = tmp_path / "config"
+    cfg_file.write_text(
+        json.dumps(
+            {
+                "current-context": "ctx",
+                "contexts": [
+                    {"name": "ctx",
+                     "context": {"cluster": "c1", "user": "u1"}}
+                ],
+                "clusters": [
+                    {"name": "c1", "cluster": {"server": "https://x:6443"}}
+                ],
+                "users": [
+                    {"name": "u1",
+                     "user": {"exec": {"command": "gke-gcloud-auth-plugin"}}}
+                ],
+            }
+        )
+    )
+    with pytest.raises(RuntimeError, match="credential plugin"):
+        KubeConfig.from_kubeconfig(str(cfg_file))
+
+
+def test_kubeconfig_env_path_list(tmp_path, monkeypatch):
+    """KUBECONFIG may be a colon-separated list (kubectl semantics):
+    the first existing file wins."""
+    cfg_file = tmp_path / "config2"
+    cfg_file.write_text(
+        json.dumps(
+            {
+                "current-context": "ctx",
+                "contexts": [
+                    {"name": "ctx",
+                     "context": {"cluster": "c1", "user": "u1"}}
+                ],
+                "clusters": [
+                    {"name": "c1", "cluster": {"server": "https://y:6443"}}
+                ],
+                "users": [{"name": "u1", "user": {"token": "t2"}}],
+            }
+        )
+    )
+    monkeypatch.setenv(
+        "KUBECONFIG", f"{tmp_path}/does-not-exist:{cfg_file}"
+    )
+    cfg = KubeConfig.from_kubeconfig()
+    assert cfg.host == "https://y:6443"
+    assert cfg.token == "t2"
+
+
+def test_kubeconfig_missing_context(tmp_path):
+    cfg_file = tmp_path / "config"
+    cfg_file.write_text(json.dumps({"current-context": "nope"}))
+    with pytest.raises(RuntimeError, match="not found"):
+        KubeConfig.from_kubeconfig(str(cfg_file))
+
+
+# --- converters -------------------------------------------------------------
+
+
+def test_node_from_json_defaults():
+    node = node_from_json({"metadata": {"name": "n"}})
+    assert node.name == "n"
+    assert node.is_ready()  # no conditions -> ready (reference semantics)
+    assert not node.spec.unschedulable
+
+
+def test_pod_from_json_orphan():
+    pod = pod_from_json({"metadata": {"name": "p", "namespace": "d"}})
+    assert pod.is_orphaned()
+    assert not pod.all_containers_ready()  # no statuses -> not ready
