@@ -53,10 +53,21 @@ class ConflictError(RuntimeError):
 
 
 class EvictionBlockedError(RuntimeError):
-    """Eviction rejected by a PodDisruptionBudget (HTTP 429).
+    """Eviction rejected by a PodDisruptionBudget (HTTP 429 on the
+    Eviction subresource).
 
     kubectl drain retries these until the drain timeout; DrainHelper does
     the same."""
+
+
+class ThrottledError(RuntimeError):
+    """Request throttled by apiserver priority & fairness (HTTP 429 on a
+    non-eviction path).  Retryable; carries the server's Retry-After
+    seconds when provided."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 _HISTORY_CAP = 64
@@ -300,6 +311,10 @@ class FakeCluster:
         """Eviction-API analogue (what drain actually calls)."""
         self._call("evict_pod")
         with self._lock:
+            # Existence first: the real API 404s a deleted pod before any
+            # PDB admission check.
+            if self._pods.get_live(self._pod_key(namespace, name)) is None:
+                raise NotFoundError(f"pod {namespace}/{name}")
             if (namespace, name) in self._eviction_blocked:
                 raise EvictionBlockedError(
                     f"Cannot evict pod {namespace}/{name}: disruption budget"
@@ -314,6 +329,7 @@ class FakeCluster:
                 raise NotFoundError(f"pod {namespace}/{name}")
             pod.metadata.deletion_timestamp = time.time()
             self._pods.delete(key)
+            self._eviction_blocked.discard(key)
             hooks = list(self._pod_deleted_hooks)
         for hook in hooks:
             hook(pod)

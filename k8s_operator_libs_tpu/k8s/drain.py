@@ -24,6 +24,7 @@ from k8s_operator_libs_tpu.k8s.client import (
     EvictionBlockedError,
     FakeCluster,
     NotFoundError,
+    ThrottledError,
 )
 from k8s_operator_libs_tpu.k8s.objects import Node, Pod
 from k8s_operator_libs_tpu.k8s.selectors import matches_selector
@@ -150,8 +151,8 @@ class DrainHelper:
                 except NotFoundError:
                     to_evict.discard(key)  # already gone
                     continue
-                except EvictionBlockedError:
-                    continue  # PDB: retry next round
+                except (EvictionBlockedError, ThrottledError):
+                    continue  # PDB / apiserver throttle: retry next round
                 to_evict.discard(key)
                 if self.on_pod_deleted is not None:
                     self.on_pod_deleted(by_key[key], True)
@@ -162,6 +163,8 @@ class DrainHelper:
                     self.client.get_pod(ns, name)
                 except NotFoundError:
                     gone.add((ns, name))
+                except ThrottledError:
+                    break  # back off this round; deadline still applies
             pending -= gone
             if not pending:
                 return

@@ -19,14 +19,14 @@ from __future__ import annotations
 import atexit
 import base64
 import datetime
+import http.client
 import json
 import os
 import ssl
 import tempfile
+import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
 from collections import Counter
 from dataclasses import dataclass
 from typing import Optional
@@ -36,6 +36,7 @@ from k8s_operator_libs_tpu.k8s.client import (
     ConflictError,
     EvictionBlockedError,
     NotFoundError,
+    ThrottledError,
 )
 from k8s_operator_libs_tpu.k8s.objects import (
     ContainerStatus,
@@ -331,12 +332,22 @@ class RestClient:
 
     # Bound SA tokens rotate; re-read the token file at most this often.
     TOKEN_REFRESH_S = 60.0
+    # Idle keep-alive connections retained per client.
+    POOL_SIZE = 8
 
     def __init__(self, config: KubeConfig, timeout_s: float = 30.0) -> None:
         self.config = config
         self.timeout_s = timeout_s
         self.stats: Counter = Counter()
         self._token = config.token
+        if not self._token and config.token_path:
+            # Token supplied only as a file: read it now, not after the
+            # first refresh interval.
+            try:
+                with open(config.token_path) as f:
+                    self._token = f.read().strip()
+            except OSError:
+                pass
         self._token_read_at = time.monotonic()
         ctx = ssl.create_default_context()
         if config.insecure_skip_tls_verify:
@@ -349,6 +360,15 @@ class RestClient:
                 config.client_cert_path, config.client_key_path
             )
         self._ssl = ctx
+        url = urllib.parse.urlsplit(config.host)
+        self._https = url.scheme != "http"
+        self._netloc = url.hostname or ""
+        self._port = url.port or (443 if self._https else 80)
+        # Keep-alive connection pool: drain/eviction workers poll the API
+        # concurrently, and per-request TLS handshakes would dominate
+        # drain latency on multi-host slices.
+        self._pool: list[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
 
     # -- transport ---------------------------------------------------------
 
@@ -370,6 +390,46 @@ class RestClient:
             self._token_read_at = time.monotonic()
         return self._token
 
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        if self._https:
+            return http.client.HTTPSConnection(
+                self._netloc,
+                self._port,
+                timeout=self.timeout_s,
+                context=self._ssl,
+            )
+        return http.client.HTTPConnection(
+            self._netloc, self._port, timeout=self.timeout_s
+        )
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self.POOL_SIZE:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    @staticmethod
+    def _stat_key(method: str, path: str) -> str:
+        """Bounded stats key: verb + resource kind (names stripped), so a
+        weeks-long controller doesn't grow the Counter per object."""
+        parts = [p for p in path.split("/") if p]
+        kind = "?"
+        for known in (
+            "eviction",
+            "controllerrevisions",
+            "daemonsets",
+            "pods",
+            "nodes",
+        ):
+            if known in parts:
+                kind = known
+                break
+        return f"{method} {kind}"
+
     def _request(
         self,
         method: str,
@@ -378,41 +438,64 @@ class RestClient:
         body: Optional[dict] = None,
         content_type: str = JSON,
     ) -> dict:
-        url = self.config.host + path
+        target = path
         if query:
-            url += "?" + urllib.parse.urlencode(
+            encoded = urllib.parse.urlencode(
                 {k: v for k, v in query.items() if v}
             )
+            if encoded:
+                target += "?" + encoded
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", JSON)
+        headers = {"Accept": JSON, "Host": self._netloc}
         if data is not None:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         token = self._current_token()
         if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        self.stats[f"{method} {path.split('?')[0]}"] += 1
+            headers["Authorization"] = f"Bearer {token}"
+        self.stats[self._stat_key(method, path)] += 1
+
+        conn = self._get_conn()
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout_s, context=self._ssl
-            ) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:512]
-            if e.code == 404:
-                raise NotFoundError(f"{method} {path}: {detail}") from e
-            if e.code == 409:
-                raise ConflictError(f"{method} {path}: {detail}") from e
-            if e.code == 429:
-                # PodDisruptionBudget rejecting an eviction; DrainHelper
-                # retries these until its timeout (kubectl semantics).
-                raise EvictionBlockedError(
-                    f"{method} {path}: {detail}"
-                ) from e
-            raise RuntimeError(
-                f"apiserver {method} {path} -> {e.code}: {detail}"
-            ) from e
-        return json.loads(payload) if payload else {}
+            try:
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                # Stale keep-alive connection: reconnect once.
+                conn.close()
+                conn = self._get_conn()
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+            payload = resp.read()
+            status = resp.status
+            retry_after = resp.getheader("Retry-After")
+        except Exception:
+            conn.close()
+            raise
+        self._put_conn(conn)
+
+        if status < 300:
+            return json.loads(payload) if payload else {}
+        detail = payload.decode(errors="replace")[:512]
+        if status == 404:
+            raise NotFoundError(f"{method} {path}: {detail}")
+        if status == 409:
+            raise ConflictError(f"{method} {path}: {detail}")
+        if status == 429:
+            if path.endswith("/eviction"):
+                # PodDisruptionBudget rejecting the eviction; DrainHelper
+                # retries until its timeout (kubectl semantics).
+                raise EvictionBlockedError(f"{method} {path}: {detail}")
+            # Priority & fairness throttling on any other verb.
+            try:
+                after = float(retry_after or 1.0)
+            except ValueError:
+                after = 1.0
+            raise ThrottledError(
+                f"{method} {path} throttled: {detail}", retry_after_s=after
+            )
+        raise RuntimeError(
+            f"apiserver {method} {path} -> {status}: {detail}"
+        )
 
     # -- nodes -------------------------------------------------------------
 

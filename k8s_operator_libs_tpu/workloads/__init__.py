@@ -1,0 +1,29 @@
+"""Reference JAX workloads.
+
+The reference manages the driver that NCCL/InfiniBand *workloads* depend
+on, but contains no workload code (SURVEY.md §2.3).  For the TPU north
+star the workload is first-class: BASELINE configs 3-5 measure *JAX
+workload downtime* during a rolling libtpu upgrade, so the framework
+ships a canary — a small sharded transformer LM train step (the MaxText
+stand-in) plus a runner that timestamps steps and reports interruption
+gaps.  The canary is also the flagship compute surface for the harness
+entry points (``__graft_entry__.py``).
+"""
+
+from k8s_operator_libs_tpu.workloads.canary import (
+    CanaryConfig,
+    CanaryRunner,
+    init_params,
+    make_mesh,
+    make_sharded_train_step,
+    make_train_step,
+)
+
+__all__ = [
+    "CanaryConfig",
+    "CanaryRunner",
+    "init_params",
+    "make_mesh",
+    "make_sharded_train_step",
+    "make_train_step",
+]

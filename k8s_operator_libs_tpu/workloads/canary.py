@@ -1,0 +1,325 @@
+"""Canary workload: a sharded transformer LM train step.
+
+A compact decoder-only transformer (the MaxText/Llama stand-in from
+BASELINE configs 4-5) written TPU-first:
+
+- **MXU**: all matmuls run in bf16 with f32 accumulation
+  (``preferred_element_type``), static shapes throughout;
+- **compiler-friendly control flow**: the layer stack is a single
+  ``lax.scan`` over stacked layer parameters — one trace, XLA unrolls
+  onto the MXU pipeline;
+- **SPMD**: parameters and data carry ``NamedSharding`` over a
+  ``("dp", "tp")`` mesh — batch over ``dp``, attention heads and MLP
+  hidden over ``tp`` (Megatron-style column→row sharding, so each layer
+  needs exactly one all-reduce per projection pair, which XLA inserts
+  from the shardings; no hand-written collectives);
+- **downtime measurement**: :class:`CanaryRunner` timestamps every step
+  so an upgrade's workload interruption is measured, not estimated — the
+  north-star metric (<2 min interruption on v5p-64).
+
+The model is deliberately small-configurable: the same code path
+compiles at toy size on the 8-device CPU test mesh and at benchmark size
+on real slices.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_operator_libs_tpu.consts import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass(frozen=True)
+class CanaryConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 128
+    batch: int = 8
+    learning_rate: float = 1e-3
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: CanaryConfig) -> dict:
+    """Parameter pytree; per-layer tensors are STACKED on a leading
+    layer axis so the forward pass is one ``lax.scan``."""
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    scale = cfg.d_model**-0.5
+    L = cfg.n_layers
+
+    def norm(key, *shape):
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    ks = jax.random.split(k_layers, 6)
+    return {
+        "embed": norm(k_embed, cfg.vocab, cfg.d_model),
+        "layers": {
+            "qkv": norm(ks[0], L, cfg.d_model, 3 * cfg.d_model),
+            "proj": norm(ks[1], L, cfg.d_model, cfg.d_model),
+            "mlp_in": norm(ks[2], L, cfg.d_model, cfg.d_ff),
+            "mlp_out": norm(ks[3], L, cfg.d_ff, cfg.d_model),
+            "ln1": jnp.ones((L, cfg.d_model), jnp.float32),
+            "ln2": jnp.ones((L, cfg.d_model), jnp.float32),
+        },
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "out": norm(k_out, cfg.d_model, cfg.vocab),
+    }
+
+
+def param_specs(cfg: CanaryConfig) -> dict:
+    """Megatron-style tensor-parallel PartitionSpecs (leading axis of the
+    stacked layer tensors is never sharded).
+
+    qkv / mlp_in are column-parallel (output dim over ``tp``); proj /
+    mlp_out are row-parallel (input dim over ``tp``): activations stay
+    sharded head-wise through attention and hidden-wise through the MLP,
+    and XLA inserts exactly one all-reduce after each row-parallel matmul."""
+    return {
+        "embed": P(None, "tp"),
+        "layers": {
+            "qkv": P(None, None, "tp"),
+            "proj": P(None, "tp", None),
+            "mlp_in": P(None, None, "tp"),
+            "mlp_out": P(None, "tp", None),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+        "ln_f": P(None),
+        "out": P(None, "tp"),
+    }
+
+
+def _rms_norm(x: jax.Array, gain: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * gain
+
+
+def _matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """bf16 operands, f32 accumulation: the MXU contract."""
+    return jnp.matmul(
+        a.astype(jnp.bfloat16),
+        b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def forward(params: dict, tokens: jax.Array, cfg: CanaryConfig) -> jax.Array:
+    """Logits [B, S, V].  Layer stack via lax.scan (static depth, one
+    trace); causal mask is a static constant."""
+    B, S = tokens.shape
+    h = params["embed"][tokens]  # [B, S, D] gather
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def layer(h, lp):
+        x = _rms_norm(h, lp["ln1"])
+        qkv = _matmul(x, lp["qkv"])  # [B, S, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(
+                0, 2, 1, 3
+            )
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk",
+            q.astype(jnp.bfloat16),
+            k.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            attn.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
+        h = h + _matmul(ctx, lp["proj"])
+        x = _rms_norm(h, lp["ln2"])
+        h = h + _matmul(jax.nn.gelu(_matmul(x, lp["mlp_in"])), lp["mlp_out"])
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    h = _rms_norm(h, params["ln_f"])
+    return _matmul(h, params["out"])  # [B, S, V]
+
+
+def loss_fn(params: dict, batch: jax.Array, cfg: CanaryConfig) -> jax.Array:
+    """Next-token cross entropy (batch carries S+1 tokens)."""
+    tokens, targets = batch[:, :-1], batch[:, 1:]
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: CanaryConfig, optimizer=None):
+    """(params, opt_state, batch) -> (params, opt_state, loss), jittable."""
+    opt = optimizer or optax.adam(cfg.learning_rate)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step, opt
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    tp: int = 0,
+) -> Mesh:
+    """A ``("dp", "tp")`` mesh over the given devices.  ``tp=0`` picks the
+    largest power-of-two ≤ min(4, n/2) that divides n, so both axes are
+    nontrivial from 4 devices up (heads are few; wide tp rarely helps a
+    canary)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if tp <= 0:
+        tp = 1
+        while tp * 2 <= min(n // 2, 4) and n % (tp * 2) == 0:
+            tp *= 2
+    if n % tp:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    return Mesh(np.asarray(devs).reshape(n // tp, tp), ("dp", "tp"))
+
+
+def make_sharded_train_step(
+    mesh: Mesh, cfg: CanaryConfig, optimizer=None
+):
+    """Jit the train step over the mesh with explicit NamedShardings.
+
+    Returns (jitted_step, shard_params, shard_batch): callers place
+    params/opt-state/batches with the shard_* helpers and then every step
+    is pure SPMD — XLA inserts the tp all-reduces and dp grad psums from
+    the sharding annotations (scaling-book recipe: pick a mesh, annotate,
+    let XLA place collectives)."""
+    step, opt = make_train_step(cfg, optimizer)
+    pspecs = param_specs(cfg)
+    param_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    batch_sh = NamedSharding(mesh, P("dp", None))
+
+    def shard_params(params):
+        return jax.device_put(params, param_sh)
+
+    def shard_batch(batch):
+        return jax.device_put(batch, batch_sh)
+
+    def shard_opt_state(params, opt_state):
+        # Optimizer moments mirror the param shardings; scalar counts
+        # replicate.  jax.jit would infer this, but placing explicitly
+        # avoids a resharding step at first call.  Moments live in the
+        # optimizer state as params-shaped subtrees, so match each state
+        # leaf to the param whose tree path is a SUFFIX of the state
+        # leaf's path (shape matching would pick wrong when two params
+        # share a shape).
+        def path_keys(path) -> tuple[str, ...]:
+            return tuple(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+            )
+
+        params_flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        sh_flat = jax.tree_util.tree_flatten_with_path(param_sh)[0]
+        by_path = {
+            path_keys(ppath): (pleaf.shape, sh)
+            for (ppath, pleaf), (_, sh) in zip(params_flat, sh_flat)
+        }
+
+        def place(path, leaf):
+            keys = path_keys(path)
+            for plen in range(len(keys), 0, -1):
+                entry = by_path.get(keys[-plen:])
+                if entry is not None and entry[0] == leaf.shape:
+                    return jax.device_put(leaf, entry[1])
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+        return jax.tree_util.tree_map_with_path(place, opt_state)
+
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+    return jitted, opt, shard_params, shard_batch, shard_opt_state
+
+
+class CanaryRunner:
+    """Run train steps and timestamp them; the gap analysis IS the
+    workload-downtime metric (north star: <2 min interruption)."""
+
+    def __init__(self, cfg: CanaryConfig, mesh: Optional[Mesh] = None,
+                 seed: int = 0) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        rng = jax.random.PRNGKey(seed)
+        params = init_params(rng, cfg)
+        if mesh is not None:
+            (
+                self._step,
+                opt,
+                shard_params,
+                shard_batch,
+                shard_opt_state,
+            ) = make_sharded_train_step(mesh, cfg)
+            self.params = shard_params(params)
+            self.opt_state = shard_opt_state(
+                self.params, opt.init(jax.tree.map(np.asarray, params))
+            )
+            self._shard_batch = shard_batch
+        else:
+            step, opt = make_train_step(cfg)
+            self._step = jax.jit(step, donate_argnums=(0, 1))
+            self.params = params
+            self.opt_state = opt.init(params)
+            self._shard_batch = lambda b: b
+        self.step_times: list[float] = []
+        self.losses: list[float] = []
+        self._batch_rng = np.random.default_rng(seed)
+
+    def _make_batch(self) -> jax.Array:
+        batch = self._batch_rng.integers(
+            0, self.cfg.vocab, (self.cfg.batch, self.cfg.seq_len + 1),
+            dtype=np.int32,
+        )
+        return self._shard_batch(jnp.asarray(batch))
+
+    def run_step(self) -> float:
+        batch = self._make_batch()
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, batch
+        )
+        loss = float(loss)
+        self.step_times.append(time.monotonic())
+        self.losses.append(loss)
+        return loss
+
+    def reset_timing(self) -> None:
+        """Start a fresh measurement window (call after warmup steps so
+        compile time doesn't count as an interruption)."""
+        self.step_times = []
+        self.losses = []
+
+    def max_gap_seconds(self) -> float:
+        """Longest interruption between consecutive completed steps."""
+        if len(self.step_times) < 2:
+            return 0.0
+        diffs = np.diff(np.asarray(self.step_times))
+        return float(diffs.max())

@@ -1,0 +1,71 @@
+"""Canary workload tests on the 8-device virtual CPU mesh."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from k8s_operator_libs_tpu.workloads import (
+    CanaryConfig,
+    CanaryRunner,
+    make_mesh,
+)
+
+TINY = CanaryConfig(
+    vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=128, seq_len=16,
+    batch=8,
+)
+
+
+def test_mesh_default_split(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+
+
+def test_mesh_explicit_tp(cpu_devices):
+    mesh = make_mesh(cpu_devices, tp=2)
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh(cpu_devices[:6], tp=4)
+
+
+def test_sharded_training_decreases_loss(cpu_devices):
+    runner = CanaryRunner(TINY, make_mesh(cpu_devices))
+    for _ in range(5):
+        runner.run_step()
+    assert np.isfinite(runner.losses).all()
+    assert runner.losses[-1] < runner.losses[0]
+
+
+def test_sharded_matches_single_device(cpu_devices):
+    """TP+DP sharding is numerically equivalent to the unsharded step —
+    the SPMD partitioning must not change the math."""
+    sharded = CanaryRunner(TINY, make_mesh(cpu_devices), seed=7)
+    single = CanaryRunner(TINY, None, seed=7)
+    for _ in range(3):
+        l_sh = sharded.run_step()
+        l_si = single.run_step()
+        assert l_sh == pytest.approx(l_si, rel=2e-2)
+
+
+def test_gap_measurement():
+    runner = CanaryRunner(TINY)
+    runner.run_step()
+    runner.run_step()
+    import time
+
+    time.sleep(0.05)
+    runner.run_step()
+    assert runner.max_gap_seconds() >= 0.05
+    runner.reset_timing()
+    assert runner.max_gap_seconds() == 0.0
+
+
+def test_graft_entry_single_and_multichip(cpu_devices):
+    import __graft_entry__
+    import jax
+
+    fn, args = __graft_entry__.entry()
+    loss = jax.jit(fn)(*args)
+    assert np.isfinite(float(loss))
+    __graft_entry__.dryrun_multichip(8)
