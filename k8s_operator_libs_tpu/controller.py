@@ -1,0 +1,229 @@
+"""The upgrade controller: reconcile loop + CLI.
+
+The reference is a library whose consumers (GPU/Network Operator) own the
+reconcile loop (SURVEY.md §1 "consumer operators — outside this repo").
+For TPU node pools the consumer is in-repo: this module wires the driver
+DaemonSet reconciler, the slice-aware state manager, the health gate and
+metrics into one loop, runnable as::
+
+    python -m k8s_operator_libs_tpu.controller \
+        --namespace kube-system --selector app=libtpu-driver \
+        --policy-file policy.yaml --interval 30 --metrics-port 8081
+
+The policy YAML is the same camelCase shape a CRD would embed
+(api.v1alpha1 round-trips it), e.g.::
+
+    autoUpgrade: true
+    maxParallelUpgrades: 1
+    maxUnavailable: 25%
+    drain: {enable: true, timeoutSeconds: 300}
+    sliceAtomic: true
+    healthGate: {enable: true, timeoutSeconds: 600}
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_operator_libs_tpu.api.v1alpha1 import (
+    DriverUpgradePolicySpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.driver.daemonset import (
+    DriverDaemonSetSpec,
+    DriverSetReconciler,
+)
+from k8s_operator_libs_tpu.health import NodeReportProber
+from k8s_operator_libs_tpu.metrics import (
+    MetricsRegistry,
+    MetricsServer,
+    SliceUpgradeTimer,
+    UpgradeMetrics,
+)
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder, UpgradeKeys
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class ControllerConfig:
+    namespace: str = "kube-system"
+    driver_labels: dict[str, str] = field(
+        default_factory=lambda: {"app": "libtpu-driver"}
+    )
+    driver_name: str = "libtpu"
+    interval_s: float = 30.0
+    policy: Optional[DriverUpgradePolicySpec] = None
+    # When set, the controller also owns the driver DaemonSet.
+    daemonset_spec: Optional[DriverDaemonSetSpec] = None
+    metrics_port: Optional[int] = None
+
+
+class UpgradeController:
+    """Owns one driver's upgrade lifecycle end to end."""
+
+    def __init__(self, client, config: ControllerConfig) -> None:
+        self.client = client
+        self.config = config
+        self.keys = UpgradeKeys(driver_name=config.driver_name)
+        self.events = EventRecorder()
+        self.manager = ClusterUpgradeStateManager(
+            client, keys=self.keys, event_recorder=self.events
+        )
+        # TPU health gate: per-host probe-agent reports aggregated per
+        # slice, pinned to the current driver revision.
+        self.manager.with_validation_enabled(
+            NodeReportProber(
+                self.keys,
+                revision_resolver=(
+                    self.manager.pod_manager
+                    .get_daemonset_controller_revision_hash
+                ),
+            )
+        )
+        self.ds_reconciler = (
+            DriverSetReconciler(client, config.daemonset_spec)
+            if config.daemonset_spec is not None
+            else None
+        )
+        self.registry = MetricsRegistry()
+        self.metrics = UpgradeMetrics(self.registry)
+        self.slice_timer = SliceUpgradeTimer(self.registry)
+        self._stop = False
+
+    def reconcile_once(self) -> bool:
+        """One full pass; returns False when the snapshot was incoherent
+        (requeue and retry, reference reconcile-error semantics)."""
+        t0 = time.monotonic()
+        if self.ds_reconciler is not None:
+            self.ds_reconciler.reconcile()
+        try:
+            state = self.manager.build_state(
+                self.config.namespace,
+                self.config.driver_labels,
+                self.config.policy,
+            )
+        except BuildStateError as e:
+            logger.warning("build_state: %s (requeueing)", e)
+            return False
+        self.manager.apply_state(state, self.config.policy)
+        duration = time.monotonic() - t0
+        self.metrics.observe(self.manager, state, duration)
+        self.slice_timer.observe_state(state)
+        for ev in self.events.drain():
+            logger.info(
+                "event %s %s %s: %s",
+                ev.event_type,
+                ev.object_name,
+                ev.reason,
+                ev.message,
+            )
+        return True
+
+    def stop(self, *_args) -> None:
+        self._stop = True
+
+    def run_forever(self) -> None:
+        server = None
+        if self.config.metrics_port is not None:
+            server = MetricsServer(self.registry, self.config.metrics_port)
+            server.start()
+        logger.info(
+            "upgrade controller started: ns=%s selector=%s interval=%.0fs",
+            self.config.namespace,
+            self.config.driver_labels,
+            self.config.interval_s,
+        )
+        try:
+            while not self._stop:
+                try:
+                    self.reconcile_once()
+                except Exception:  # noqa: BLE001 — loop must survive
+                    logger.exception("reconcile pass failed")
+                deadline = time.monotonic() + self.config.interval_s
+                while not self._stop and time.monotonic() < deadline:
+                    time.sleep(0.2)
+        finally:
+            if server is not None:
+                server.stop()
+
+
+def _parse_labels(raw: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for part in raw.split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def load_policy(path: Optional[str]) -> DriverUpgradePolicySpec:
+    if not path:
+        return TPUUpgradePolicySpec(auto_upgrade=True)
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    return TPUUpgradePolicySpec.from_dict(data)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--namespace", default="kube-system")
+    parser.add_argument(
+        "--selector",
+        default="app=libtpu-driver",
+        help="driver pod label selector, k=v[,k2=v2]",
+    )
+    parser.add_argument("--driver-name", default="libtpu")
+    parser.add_argument("--interval", type=float, default=30.0)
+    parser.add_argument("--policy-file", default="")
+    parser.add_argument("--metrics-port", type=int, default=None)
+    parser.add_argument(
+        "--manage-daemonset",
+        action="store_true",
+        help="also reconcile the libtpu device-plugin DaemonSet",
+    )
+    parser.add_argument("--driver-image", default="")
+    parser.add_argument("--driver-version", default="latest")
+    args = parser.parse_args(argv)
+
+    from k8s_operator_libs_tpu.k8s import get_default_client
+
+    ds_spec = None
+    if args.manage_daemonset:
+        ds_spec = DriverDaemonSetSpec(
+            namespace=args.namespace,
+            driver_name=args.driver_name,
+            version=args.driver_version,
+            **({"image": args.driver_image} if args.driver_image else {}),
+        )
+    controller = UpgradeController(
+        get_default_client(),
+        ControllerConfig(
+            namespace=args.namespace,
+            driver_labels=_parse_labels(args.selector),
+            driver_name=args.driver_name,
+            interval_s=args.interval,
+            policy=load_policy(args.policy_file),
+            daemonset_spec=ds_spec,
+            metrics_port=args.metrics_port,
+        ),
+    )
+    signal.signal(signal.SIGTERM, controller.stop)
+    signal.signal(signal.SIGINT, controller.stop)
+    controller.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Driver-side components: the libtpu DaemonSet and its node-side agents.
+
+The reference assumes an out-of-repo NVIDIA driver container managed by
+consumer operators; the TPU north star replaces that with an in-repo
+**libtpu device-plugin reconciler** (BASELINE.json) plus the node-side
+half of the safe-load handshake (reference docs/automatic-ofed-upgrade.md:57-63
+describes the protocol; the init container itself lives outside the
+reference repo — here it is first-class):
+
+- :mod:`daemonset` — spec builder + reconciler for the libtpu driver /
+  device-plugin DaemonSet (OnDelete update strategy so the upgrade state
+  machine, not the DS controller, rolls the pods);
+- :mod:`safe_load_init` — the init-container entrypoint that blocks
+  libtpu load until the controller confirms the slice is quiesced.
+"""
+
+from k8s_operator_libs_tpu.driver.daemonset import (
+    DriverDaemonSetSpec,
+    DriverSetReconciler,
+    build_daemon_set,
+)
+from k8s_operator_libs_tpu.driver.safe_load_init import (
+    announce_and_wait,
+)
+
+__all__ = [
+    "DriverDaemonSetSpec",
+    "DriverSetReconciler",
+    "announce_and_wait",
+    "build_daemon_set",
+]

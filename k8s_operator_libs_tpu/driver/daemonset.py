@@ -1,0 +1,216 @@
+"""libtpu device-plugin DaemonSet: spec builder + reconciler.
+
+The genuinely new "thin TPU device-plugin reconciler" from the north star
+(BASELINE.json): where the reference assumes consumer operators deploy an
+NVIDIA driver container, this module *owns* the driver DaemonSet —
+building a deterministic spec for the libtpu/device-plugin pod and
+reconciling the live object toward it.
+
+Design points:
+
+- **OnDelete update strategy**: when the template changes, the DS
+  controller records a new ControllerRevision but does NOT restart pods;
+  the upgrade state machine detects outdated pods via revision hashes
+  (pod_manager parity with reference pod_manager.go:87-121) and rolls
+  them slice-atomically.  The DS controller must never split a torus on
+  its own.
+- **Template hashing**: the reconciler annotates the DaemonSet with a
+  content hash of the desired template; drift (image bump, env change)
+  is detected by hash comparison, so reconcile is cheap and idempotent.
+- **Safe-load init container**: optional; runs
+  ``python -m k8s_operator_libs_tpu.driver.safe_load_init``, which holds
+  libtpu load until the controller has quiesced the slice (§3.5 protocol).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import NotFoundError
+from k8s_operator_libs_tpu.k8s.objects import (
+    DaemonSet,
+    DaemonSetSpec,
+    LabelSelectorSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+)
+from k8s_operator_libs_tpu.topology.slices import GKE_TPU_ACCELERATOR_LABEL
+
+logger = get_logger(__name__)
+
+TEMPLATE_HASH_ANNOTATION = "tpu.google.com/driver-template-hash"
+
+
+@dataclass
+class DriverDaemonSetSpec:
+    """Desired state of the libtpu driver / device-plugin DaemonSet."""
+
+    name: str = "libtpu-device-plugin"
+    namespace: str = "kube-system"
+    image: str = "registry.local/libtpu-device-plugin"
+    version: str = "latest"
+    driver_name: str = "libtpu"
+    # Schedule onto every TPU node (any accelerator type) by default; set
+    # to restrict to one accelerator family.
+    accelerator: Optional[str] = None
+    safe_load: bool = True
+    env: dict[str, str] = field(default_factory=dict)
+    extra_labels: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def selector_labels(self) -> dict[str, str]:
+        """The IMMUTABLE pod selector: a stable minimal subset (the
+        apiserver rejects any spec.selector change for the DaemonSet's
+        lifetime, so extra_labels must never leak in here)."""
+        return {"app": f"{self.driver_name}-driver"}
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return {
+            **self.selector_labels,
+            "app.kubernetes.io/managed-by": "tpu-operator-libs",
+            **self.extra_labels,
+        }
+
+
+def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
+    """Raw podSpec JSON for the driver pod (serialized verbatim by the
+    REST client)."""
+    env = [{"name": k, "value": v} for k, v in sorted(spec.env.items())]
+    env.append(
+        {
+            "name": "NODE_NAME",
+            "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
+        }
+    )
+    node_selector: dict[str, str] = {}
+    if spec.accelerator:
+        node_selector[GKE_TPU_ACCELERATOR_LABEL] = spec.accelerator
+    pod: dict = {
+        "priorityClassName": "system-node-critical",
+        "hostNetwork": True,
+        "tolerations": [
+            # TPU nodes carry the google.com/tpu taint; the driver (like
+            # any device plugin) must land there anyway — and must also
+            # survive the cordon its own upgrade performs.
+            {"key": "google.com/tpu", "operator": "Exists"},
+            {"key": "node.kubernetes.io/unschedulable",
+             "operator": "Exists", "effect": "NoSchedule"},
+        ],
+        "containers": [
+            {
+                "name": "device-plugin",
+                "image": f"{spec.image}:{spec.version}",
+                "env": env,
+                "securityContext": {"privileged": True},
+                "volumeMounts": [
+                    {"name": "device-plugin-dir",
+                     "mountPath": "/var/lib/kubelet/device-plugins"},
+                    {"name": "libtpu-dir", "mountPath": "/usr/lib/libtpu"},
+                ],
+            }
+        ],
+        "volumes": [
+            {"name": "device-plugin-dir",
+             "hostPath": {"path": "/var/lib/kubelet/device-plugins"}},
+            {"name": "libtpu-dir",
+             "hostPath": {"path": "/usr/lib/libtpu",
+                          "type": "DirectoryOrCreate"}},
+        ],
+    }
+    if node_selector:
+        pod["nodeSelector"] = node_selector
+    if spec.safe_load:
+        pod["initContainers"] = [
+            {
+                "name": "safe-load",
+                "image": f"{spec.image}:{spec.version}",
+                "command": [
+                    "python",
+                    "-m",
+                    "k8s_operator_libs_tpu.driver.safe_load_init",
+                ],
+                "env": env + [
+                    {"name": "DRIVER_NAME", "value": spec.driver_name}
+                ],
+            }
+        ]
+    return pod
+
+
+def template_hash(spec: DriverDaemonSetSpec) -> str:
+    """Content hash of everything that defines the pod template."""
+    blob = json.dumps(
+        {"pod": _pod_spec(spec), "labels": spec.labels},
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_daemon_set(spec: DriverDaemonSetSpec) -> DaemonSet:
+    return DaemonSet(
+        metadata=ObjectMeta(
+            name=spec.name,
+            namespace=spec.namespace,
+            labels=spec.labels,
+            annotations={TEMPLATE_HASH_ANNOTATION: template_hash(spec)},
+        ),
+        spec=DaemonSetSpec(
+            selector=LabelSelectorSpec(dict(spec.selector_labels)),
+            template=PodTemplateSpec(
+                labels=dict(spec.labels),
+                pod_spec=_pod_spec(spec),
+            ),
+        ),
+    )
+
+
+class DriverSetReconciler:
+    """Idempotently drive the live DaemonSet toward the desired spec."""
+
+    def __init__(self, client, spec: DriverDaemonSetSpec) -> None:
+        self.client = client
+        self.spec = spec
+
+    def reconcile(self) -> str:
+        """Returns one of "created" | "updated" | "unchanged"."""
+        desired = build_daemon_set(self.spec)
+        want_hash = desired.metadata.annotations[TEMPLATE_HASH_ANNOTATION]
+        try:
+            live = self.client.get_daemon_set(
+                self.spec.namespace, self.spec.name
+            )
+        except NotFoundError:
+            self.client.create_daemon_set(desired)
+            logger.info(
+                "created driver DaemonSet %s/%s (template %s)",
+                self.spec.namespace,
+                self.spec.name,
+                want_hash,
+            )
+            return "created"
+        live_hash = live.metadata.annotations.get(TEMPLATE_HASH_ANNOTATION)
+        if live_hash == want_hash:
+            return "unchanged"
+        # Preserve identity/metadata the apiserver owns, and NEVER rewrite
+        # spec.selector — it is immutable for the DaemonSet's lifetime and
+        # a changed selector would 422 every reconcile forever.
+        live.metadata.labels = desired.metadata.labels
+        live.metadata.annotations[TEMPLATE_HASH_ANNOTATION] = want_hash
+        desired.spec.selector = live.spec.selector
+        live.spec = desired.spec
+        self.client.update_daemon_set(live)
+        logger.info(
+            "updated driver DaemonSet %s/%s: template %s -> %s "
+            "(OnDelete: pods roll via the upgrade state machine)",
+            self.spec.namespace,
+            self.spec.name,
+            live_hash,
+            want_hash,
+        )
+        return "updated"

@@ -144,6 +144,7 @@ class DrainHelper:
         to_evict = set(by_key)
         pending = set(by_key)
         while True:
+            backoff_s = 0.0
             for key in sorted(to_evict):
                 ns, name = key
                 try:
@@ -151,8 +152,13 @@ class DrainHelper:
                 except NotFoundError:
                     to_evict.discard(key)  # already gone
                     continue
-                except (EvictionBlockedError, ThrottledError):
-                    continue  # PDB / apiserver throttle: retry next round
+                except EvictionBlockedError:
+                    continue  # PDB: retry next round
+                except ThrottledError as e:
+                    # Apiserver asked us to back off; stop hammering it
+                    # with the rest of this round and honor Retry-After.
+                    backoff_s = max(e.retry_after_s, self.poll_interval_s)
+                    break
                 to_evict.discard(key)
                 if self.on_pod_deleted is not None:
                     self.on_pod_deleted(by_key[key], True)
@@ -179,7 +185,10 @@ class DrainHelper:
                 raise DrainError(
                     "timed out draining: " + "; ".join(detail)
                 )
-            time.sleep(self.poll_interval_s)
+            sleep_s = max(self.poll_interval_s, backoff_s)
+            if deadline is not None:
+                sleep_s = min(sleep_s, max(0.0, deadline - time.monotonic()))
+            time.sleep(sleep_s)
 
     def run_node_drain(self, node_name: str) -> None:
         """Full drain: select pods, error if any fatal filter fired, evict.

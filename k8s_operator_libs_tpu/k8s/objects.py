@@ -172,6 +172,11 @@ class LabelSelectorSpec:
 @dataclass
 class PodTemplateSpec:
     labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    # Raw podSpec JSON (containers, initContainers, volumes, nodeSelector,
+    # tolerations...).  The engine never inspects it; the driver-DaemonSet
+    # reconciler builds it and the REST client serializes it verbatim.
+    pod_spec: dict = field(default_factory=dict)
 
 
 @dataclass

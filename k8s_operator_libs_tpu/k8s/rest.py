@@ -290,15 +290,17 @@ def pod_from_json(d: dict) -> Pod:
 def daemon_set_from_json(d: dict) -> DaemonSet:
     spec = d.get("spec") or {}
     selector = (spec.get("selector") or {}).get("matchLabels") or {}
-    template_labels = (
-        ((spec.get("template") or {}).get("metadata") or {}).get("labels")
-        or {}
-    )
+    template = spec.get("template") or {}
+    template_meta = template.get("metadata") or {}
     return DaemonSet(
         metadata=_meta_from_json(d.get("metadata") or {}),
         spec=DaemonSetSpec(
             selector=LabelSelectorSpec(dict(selector)),
-            template=PodTemplateSpec(labels=dict(template_labels)),
+            template=PodTemplateSpec(
+                labels=dict(template_meta.get("labels") or {}),
+                annotations=dict(template_meta.get("annotations") or {}),
+                pod_spec=dict(template.get("spec") or {}),
+            ),
         ),
         status=DaemonSetStatus(
             desired_number_scheduled=int(
@@ -306,6 +308,33 @@ def daemon_set_from_json(d: dict) -> DaemonSet:
             )
         ),
     )
+
+
+def daemon_set_to_json(ds: DaemonSet) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": ds.name,
+            "namespace": ds.namespace,
+            "labels": dict(ds.metadata.labels),
+            "annotations": dict(ds.metadata.annotations),
+        },
+        "spec": {
+            "selector": {"matchLabels": dict(ds.spec.selector.match_labels)},
+            # OnDelete: the upgrade state machine controls pod restarts
+            # (reference model — the DS controller must not roll pods
+            # behind the engine's back).
+            "updateStrategy": {"type": "OnDelete"},
+            "template": {
+                "metadata": {
+                    "labels": dict(ds.spec.template.labels),
+                    "annotations": dict(ds.spec.template.annotations),
+                },
+                "spec": dict(ds.spec.template.pod_spec),
+            },
+        },
+    }
 
 
 def controller_revision_from_json(d: dict) -> ControllerRevision:
@@ -446,7 +475,8 @@ class RestClient:
             if encoded:
                 target += "?" + encoded
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Accept": JSON, "Host": self._netloc}
+        # http.client derives the Host header (host:port / [v6]:port).
+        headers = {"Accept": JSON}
         if data is not None:
             headers["Content-Type"] = content_type
         token = self._current_token()
@@ -456,12 +486,19 @@ class RestClient:
 
         conn = self._get_conn()
         try:
+            sent = False
             try:
                 conn.request(method, target, body=data, headers=headers)
+                sent = True
                 resp = conn.getresponse()
             except (http.client.HTTPException, OSError):
-                # Stale keep-alive connection: reconnect once.
+                # Stale keep-alive connection: reconnect and retry once —
+                # but never re-send a non-idempotent request that may
+                # already have been executed (a duplicated POST would e.g.
+                # turn a successful create into a spurious 409).
                 conn.close()
+                if sent and method == "POST":
+                    raise
                 conn = self._get_conn()
                 conn.request(method, target, body=data, headers=headers)
                 resp = conn.getresponse()
@@ -595,6 +632,25 @@ class RestClient:
         )
 
     # -- daemonsets + controller revisions -----------------------------------
+
+    def create_daemon_set(self, ds: DaemonSet) -> DaemonSet:
+        return daemon_set_from_json(
+            self._request(
+                "POST",
+                f"/apis/apps/v1/namespaces/{ds.namespace}/daemonsets",
+                body=daemon_set_to_json(ds),
+            )
+        )
+
+    def update_daemon_set(self, ds: DaemonSet) -> DaemonSet:
+        return daemon_set_from_json(
+            self._request(
+                "PUT",
+                f"/apis/apps/v1/namespaces/{ds.namespace}/daemonsets/"
+                f"{ds.name}",
+                body=daemon_set_to_json(ds),
+            )
+        )
 
     def get_daemon_set(self, namespace: str, name: str) -> DaemonSet:
         return daemon_set_from_json(

@@ -1,0 +1,193 @@
+"""Observability: counters as methods (reference parity) + Prometheus text.
+
+The reference deliberately exposes upgrade counters as *methods* on the
+state manager, leaving export to consumers (SURVEY.md §5, reference
+upgrade_state.go:1038-1120 — no prometheus dependency anywhere).  We keep
+that contract and additionally ship the thin exporter consumers always
+end up writing: a snapshot-based registry rendering Prometheus text
+exposition format, served by a stdlib HTTP thread.  Gauges are slice-
+granular as well as node-granular, plus the north-star timing metrics
+(reconcile duration, per-slice upgrade wall-clock, probe latency).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+
+logger = get_logger(__name__)
+
+PREFIX = "tpu_operator"
+
+
+class MetricsRegistry:
+    """Thread-safe gauge/counter store rendering Prometheus text format."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> help text
+        self._help: dict[str, str] = {}
+        # name -> {label-tuple: value}
+        self._values: dict[str, dict[tuple, float]] = defaultdict(dict)
+        # name -> label key names
+        self._label_keys: dict[str, tuple[str, ...]] = {}
+
+    def describe(self, name: str, help_text: str, *label_keys: str) -> None:
+        with self._lock:
+            self._help[name] = help_text
+            self._label_keys[name] = tuple(label_keys)
+
+    def set(self, name: str, value: float, **labels: str) -> None:
+        with self._lock:
+            keys = self._label_keys.get(name, tuple(sorted(labels)))
+            self._values[name][tuple(labels.get(k, "") for k in keys)] = value
+
+    def inc(self, name: str, delta: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            keys = self._label_keys.get(name, tuple(sorted(labels)))
+            key = tuple(labels.get(k, "") for k in keys)
+            self._values[name][key] = self._values[name].get(key, 0.0) + delta
+
+    def clear(self, name: str) -> None:
+        """Drop all series of a gauge (before re-publishing a snapshot, so
+        removed slices/states don't linger)."""
+        with self._lock:
+            self._values[name] = {}
+
+    def render(self) -> str:
+        with self._lock:
+            lines: list[str] = []
+            for name in sorted(self._values):
+                full = f"{PREFIX}_{name}"
+                if name in self._help:
+                    lines.append(f"# HELP {full} {self._help[name]}")
+                    lines.append(f"# TYPE {full} gauge")
+                keys = self._label_keys.get(name, ())
+                for label_vals, value in sorted(self._values[name].items()):
+                    if keys:
+                        rendered = ",".join(
+                            f'{k}="{v}"' for k, v in zip(keys, label_vals)
+                        )
+                        lines.append(f"{full}{{{rendered}}} {value:g}")
+                    else:
+                        lines.append(f"{full} {value:g}")
+            return "\n".join(lines) + "\n"
+
+
+class UpgradeMetrics:
+    """Publishes a state-manager snapshot into a registry each reconcile."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        r.describe(
+            "nodes_by_state", "Managed nodes per upgrade state", "state"
+        )
+        r.describe(
+            "slices_by_state", "Upgrade groups per effective state", "state"
+        )
+        r.describe("nodes_total", "Total managed nodes")
+        r.describe("slices_total", "Total upgrade groups")
+        r.describe("upgrades_in_progress", "Nodes in any in-progress state")
+        r.describe("upgrades_done", "Nodes in upgrade-done")
+        r.describe("upgrades_failed", "Nodes in upgrade-failed")
+        r.describe("upgrades_pending", "Nodes in upgrade-required")
+        r.describe(
+            "reconcile_duration_seconds", "Last BuildState+ApplyState pass"
+        )
+        r.describe(
+            "reconcile_total", "Reconcile passes since controller start"
+        )
+        r.describe(
+            "slice_upgrade_seconds",
+            "Wall-clock of each slice's last completed upgrade",
+            "slice",
+        )
+
+    def observe(self, manager, state, duration_s: float) -> None:
+        r = self.registry
+        r.clear("nodes_by_state")
+        r.clear("slices_by_state")
+        for st in UpgradeState:
+            label = st.value or "unknown"
+            r.set(
+                "nodes_by_state", len(state.nodes_in(st)), state=label
+            )
+            r.set(
+                "slices_by_state", len(state.groups_in(st)), state=label
+            )
+        r.set("nodes_total", manager.get_total_managed_nodes(state))
+        r.set("slices_total", manager.get_total_managed_groups(state))
+        r.set("upgrades_in_progress", manager.get_upgrades_in_progress(state))
+        r.set("upgrades_done", manager.get_upgrades_done(state))
+        r.set("upgrades_failed", manager.get_upgrades_failed(state))
+        r.set("upgrades_pending", manager.get_upgrades_pending(state))
+        r.set("reconcile_duration_seconds", duration_s)
+        r.inc("reconcile_total")
+
+
+class SliceUpgradeTimer:
+    """Tracks per-slice upgrade wall-clock: starts when a slice leaves
+    done/unknown, stops when it returns to done — the north-star number."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+        self._started: dict[str, float] = {}
+
+    def observe_state(self, state) -> None:
+        # Groups arrive pre-bucketed by effective state in state.groups.
+        now = time.monotonic()
+        for label, groups in state.groups.items():
+            in_flight = label not in ("", UpgradeState.DONE.value)
+            for group in groups:
+                if in_flight and group.id not in self._started:
+                    self._started[group.id] = now
+                elif not in_flight and group.id in self._started:
+                    elapsed = now - self._started.pop(group.id)
+                    self.registry.set(
+                        "slice_upgrade_seconds", elapsed, slice=group.id
+                    )
+
+
+class MetricsServer:
+    """Serve the registry at /metrics on a stdlib HTTP thread."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0) -> None:
+        registry_ref = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = registry_ref.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+        logger.info("metrics listening on :%d/metrics", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()

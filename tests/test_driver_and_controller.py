@@ -1,0 +1,228 @@
+"""Driver DaemonSet reconciler, safe-load init container, metrics, and the
+controller reconcile loop end-to-end on the fake cluster."""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
+from k8s_operator_libs_tpu.controller import (
+    ControllerConfig,
+    UpgradeController,
+    load_policy,
+)
+from k8s_operator_libs_tpu.driver import (
+    DriverDaemonSetSpec,
+    DriverSetReconciler,
+    announce_and_wait,
+    build_daemon_set,
+)
+from k8s_operator_libs_tpu.driver.daemonset import (
+    TEMPLATE_HASH_ANNOTATION,
+    template_hash,
+)
+from k8s_operator_libs_tpu.health.agent import HealthAgent
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.metrics import (
+    MetricsRegistry,
+    MetricsServer,
+    UpgradeMetrics,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+
+# --- DaemonSet builder/reconciler ------------------------------------------
+
+
+def test_build_daemon_set_shape():
+    spec = DriverDaemonSetSpec(version="1.2.3", accelerator="tpu-v5p-slice")
+    ds = build_daemon_set(spec)
+    pod = ds.spec.template.pod_spec
+    assert pod["containers"][0]["image"].endswith(":1.2.3")
+    assert pod["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice"
+    }
+    # Safe-load init container present by default.
+    assert pod["initContainers"][0]["name"] == "safe-load"
+    # Driver must tolerate its own cordon.
+    assert any(
+        t["key"] == "node.kubernetes.io/unschedulable"
+        for t in pod["tolerations"]
+    )
+    assert TEMPLATE_HASH_ANNOTATION in ds.metadata.annotations
+
+
+def test_template_hash_tracks_content():
+    a = DriverDaemonSetSpec(version="1")
+    b = DriverDaemonSetSpec(version="2")
+    assert template_hash(a) == template_hash(a)
+    assert template_hash(a) != template_hash(b)
+    no_init = DriverDaemonSetSpec(version="1", safe_load=False)
+    assert template_hash(a) != template_hash(no_init)
+    assert "initContainers" not in build_daemon_set(no_init).spec.template.pod_spec
+
+
+def test_reconciler_create_unchanged_update():
+    cluster = FakeCluster()
+    spec = DriverDaemonSetSpec(version="1")
+    rec = DriverSetReconciler(cluster, spec)
+    assert rec.reconcile() == "created"
+    assert rec.reconcile() == "unchanged"
+    spec.version = "2"
+    assert rec.reconcile() == "updated"
+    live = cluster.get_daemon_set(spec.namespace, spec.name)
+    assert live.spec.template.pod_spec["containers"][0]["image"].endswith(":2")
+    assert rec.reconcile() == "unchanged"
+
+
+# --- safe-load init container ----------------------------------------------
+
+
+def test_safe_load_announce_and_wait_unblocks():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster)
+    node = fx.node("host-0")
+    keys = UpgradeKeys()
+
+    def controller_side():
+        # wait until announced, then unblock (what the state machine does
+        # after quiescing the slice).
+        for _ in range(100):
+            n = cluster.get_node("host-0", cached=False)
+            if keys.safe_load_annotation in n.annotations:
+                cluster.patch_node_annotations(
+                    "host-0", {keys.safe_load_annotation: None}
+                )
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=controller_side)
+    t.start()
+    assert announce_and_wait(cluster, "host-0", keys, poll_interval_s=0.01)
+    t.join()
+
+
+def test_safe_load_timeout():
+    cluster = FakeCluster()
+    ClusterFixture(cluster).node("host-0")
+    assert not announce_and_wait(
+        cluster, "host-0", poll_interval_s=0.01, timeout_s=0.05
+    )
+    # Annotation stays: the node still must go through safe-load handling.
+    n = cluster.get_node("host-0", cached=False)
+    assert UpgradeKeys().safe_load_annotation in n.annotations
+
+
+# --- metrics ----------------------------------------------------------------
+
+
+def test_metrics_registry_render():
+    r = MetricsRegistry()
+    r.describe("nodes_by_state", "Nodes per state", "state")
+    r.set("nodes_by_state", 3, state="upgrade-done")
+    r.describe("reconcile_total", "passes")
+    r.inc("reconcile_total")
+    r.inc("reconcile_total")
+    text = r.render()
+    assert 'tpu_operator_nodes_by_state{state="upgrade-done"} 3' in text
+    assert "tpu_operator_reconcile_total 2" in text
+    assert "# HELP tpu_operator_nodes_by_state Nodes per state" in text
+
+
+def test_metrics_server_serves_text():
+    r = MetricsRegistry()
+    r.describe("nodes_total", "total")
+    r.set("nodes_total", 5)
+    server = MetricsServer(r, port=0)
+    server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert "tpu_operator_nodes_total 5" in body
+    finally:
+        server.stop()
+
+
+# --- controller end-to-end ---------------------------------------------------
+
+
+def test_controller_rolls_cluster_end_to_end(cpu_devices):
+    """Full loop: driver DS outdated -> controller reconciles until every
+    slice is upgrade-done, gated by NodeReportProber on agent-published
+    reports pinned to the new revision."""
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        interval_s=0.01,
+        policy=TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            drain_spec=DrainSpec(enable=True, timeout_second=5),
+        ),
+    )
+    controller = UpgradeController(cluster, config)
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+
+    small = dict(matmul_n=64, hbm_mib=1, allreduce_elems=64)
+    for tick in range(40):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+        # probe agents publish per-host reports under the new revision
+        for n in nodes:
+            HealthAgent(
+                cluster, n.name, keys, driver_revision="v2",
+                devices=cpu_devices[:4], **small,
+            ).run_once()
+        states = {
+            n.name: cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if all(s == "upgrade-done" for s in states.values()):
+            break
+    else:
+        pytest.fail(f"controller never converged: {states}")
+
+    # One more pass so the metrics snapshot observes the final state.
+    controller.reconcile_once()
+    text = controller.registry.render()
+    assert 'nodes_by_state{state="upgrade-done"} 2' in text
+    assert "slice_upgrade_seconds" in text
+
+
+def test_load_policy_yaml(tmp_path):
+    p = tmp_path / "policy.yaml"
+    p.write_text(
+        "autoUpgrade: true\n"
+        "maxParallelUpgrades: 2\n"
+        "maxUnavailable: 25%\n"
+        "drain: {enable: true, timeoutSeconds: 120}\n"
+        "sliceAtomic: true\n"
+        "unavailabilityUnit: slice\n"
+        "healthGate: {enable: true, timeoutSeconds: 300}\n"
+    )
+    policy = load_policy(str(p))
+    assert policy.auto_upgrade
+    assert policy.max_parallel_upgrades == 2
+    assert policy.max_unavailable.value == "25%"
+    assert policy.drain_spec.timeout_second == 120
+    assert policy.health_gate.timeout_second == 300
+    assert policy.unavailability_unit == "slice"
