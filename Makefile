@@ -1,0 +1,40 @@
+# Build/test toolchain — analogue of the reference's Makefile targets
+# (make all|lint|test|cov-report, reference Makefile:60-86) for the
+# Python/JAX stack.
+
+PYTHON ?= python
+
+.PHONY: all test test-fast lint cov-report bench graft-check clean
+
+all: lint test
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Skip the slower JAX-compiling tiers (canary, ring attention, chaos).
+test-fast:
+	$(PYTHON) -m pytest tests/ -q \
+		--ignore=tests/test_canary.py \
+		--ignore=tests/test_ring_attention.py \
+		--ignore=tests/test_chaos.py
+
+lint:
+	$(PYTHON) -m pyflakes k8s_operator_libs_tpu tests bench.py \
+		__graft_entry__.py 2>/dev/null \
+		|| $(PYTHON) -m compileall -q k8s_operator_libs_tpu tests
+
+cov-report:
+	$(PYTHON) -m pytest tests/ -q --cov=k8s_operator_libs_tpu \
+		--cov-report=term-missing 2>/dev/null \
+		|| echo "pytest-cov not installed; skipping"
+
+bench:
+	$(PYTHON) bench.py
+
+graft-check:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) __graft_entry__.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -rf .pytest_cache

@@ -25,6 +25,7 @@ from k8s_operator_libs_tpu.health.probes import (
     device_inventory,
     hbm_bandwidth_probe,
     ici_allreduce_probe,
+    ici_ring_attention_probe,
     ici_ring_probe,
     matmul_probe,
     run_host_probe,
@@ -47,6 +48,7 @@ __all__ = [
     "device_inventory",
     "hbm_bandwidth_probe",
     "ici_allreduce_probe",
+    "ici_ring_attention_probe",
     "ici_ring_probe",
     "matmul_probe",
     "run_host_probe",

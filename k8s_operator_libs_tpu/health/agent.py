@@ -81,6 +81,7 @@ class HealthAgent:
         matmul_n: int = 2048,
         hbm_mib: int = 256,
         allreduce_elems: int = 1 << 20,
+        deep: bool = False,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -91,6 +92,7 @@ class HealthAgent:
         self.matmul_n = matmul_n
         self.hbm_mib = hbm_mib
         self.allreduce_elems = allreduce_elems
+        self.deep = deep
 
     def probe_once(self) -> HealthReport:
         checks = run_host_probe(
@@ -98,6 +100,7 @@ class HealthAgent:
             matmul_n=self.matmul_n,
             hbm_mib=self.hbm_mib,
             allreduce_elems=self.allreduce_elems,
+            deep=self.deep,
         )
         devs = (
             len(self.devices)
@@ -155,6 +158,7 @@ def main() -> None:
         node_name=node_name,
         driver_revision=os.environ.get(DRIVER_REVISION_ENV, ""),
         slice_wide=slice_wide,
+        deep=os.environ.get("HEALTH_DEEP_PROBE", "") == "1",
     )
     interval = float(os.environ.get("HEALTH_PROBE_INTERVAL_S", "30"))
     agent.run_forever(interval)

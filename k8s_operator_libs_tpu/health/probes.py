@@ -308,6 +308,51 @@ def ici_ring_probe(
     )
 
 
+def ici_ring_attention_probe(
+    devices: Optional[Sequence[jax.Device]] = None,
+    seq_per_device: int = 128,
+) -> CheckResult:
+    """Deep ICI soak: ring attention over the full mesh.
+
+    One psum proves the torus formed; a ring-attention pass keeps every
+    directed link under sustained, overlapping load for n rounds — the
+    traffic shape of real long-context training — and verifies the
+    result against single-device full attention.  Optional (slower than
+    the quick gate); enable for post-incident validation or periodic
+    deep checks."""
+    from k8s_operator_libs_tpu.workloads.ring_attention import (
+        ring_attention_soak,
+    )
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) < 2:
+        return CheckResult(
+            "ici_ring_attention", True, 0.0,
+            "single device; no ring to soak",
+            {"devices": float(len(devs))},
+        )
+    try:
+        res = ring_attention_soak(devs, seq_per_device=seq_per_device)
+    except Exception as e:  # noqa: BLE001
+        return CheckResult(
+            "ici_ring_attention", False, 0.0, f"ring attention failed: {e}"
+        )
+    return CheckResult(
+        "ici_ring_attention",
+        bool(res["ok"]),
+        float(res["latency_ms"]),
+        (
+            f"seq {res['global_seq']} over {res['devices']} devices, "
+            f"max err {res['max_err']:.2e}"
+        ),
+        {
+            "devices": float(res["devices"]),
+            "link_gbps": float(res["link_gbps"]),
+            "global_seq": float(res["global_seq"]),
+        },
+    )
+
+
 def run_host_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
@@ -315,6 +360,7 @@ def run_host_probe(
     hbm_mib: int = 256,
     allreduce_elems: int = 1 << 20,
     skip_ici: bool = False,
+    deep: bool = False,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
 
@@ -347,4 +393,6 @@ def run_host_probe(
             ici_allreduce_probe(devs, per_device_elems=allreduce_elems)
         )
         results.append(ici_ring_probe(devs))
+        if deep:
+            results.append(ici_ring_attention_probe(devs))
     return results

@@ -19,13 +19,15 @@ from dataclasses import dataclass, field
 
 from k8s_operator_libs_tpu.health.probes import CheckResult
 
-# Every check `run_host_probe` can emit, in emission order.
+# Every check `run_host_probe` can emit, in emission order
+# (ici_ring_attention only with deep=True).
 HEALTH_CHECKS_ALL = (
     "device_enumeration",
     "mxu_matmul",
     "hbm_bandwidth",
     "ici_allreduce",
     "ici_ring",
+    "ici_ring_attention",
 )
 
 

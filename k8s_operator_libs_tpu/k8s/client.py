@@ -127,6 +127,10 @@ class FakeCluster:
         # (namespace, name) pairs whose eviction a PodDisruptionBudget
         # currently blocks (429 in the real API) — test/bench knob.
         self._eviction_blocked: set[tuple[str, str]] = set()
+        # Optional fault injector called before every verb; raising makes
+        # the call fail like a flaky apiserver (chaos-test knob — the
+        # reference has no fault injection at all, SURVEY.md §5).
+        self.fault_injector: Optional[Callable[[str], None]] = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -134,6 +138,8 @@ class FakeCluster:
         self.stats[verb] += 1
         if self.api_latency_s > 0:
             time.sleep(self.api_latency_s)
+        if self.fault_injector is not None:
+            self.fault_injector(verb)
 
     def on_pod_deleted(self, hook: Callable[[Pod], None]) -> None:
         """Register a hook fired after a pod is deleted/evicted (lets tests

@@ -43,14 +43,24 @@ class MetricsRegistry:
             self._help[name] = help_text
             self._label_keys[name] = tuple(label_keys)
 
+    def _keys_for(self, name: str, labels: dict[str, str]) -> tuple:
+        """Label keys for a metric; an undescribed metric adopts the keys
+        of its first write (and keeps them), so render() never emits the
+        same series with and without labels."""
+        keys = self._label_keys.get(name)
+        if keys is None:
+            keys = tuple(sorted(labels))
+            self._label_keys[name] = keys
+        return keys
+
     def set(self, name: str, value: float, **labels: str) -> None:
         with self._lock:
-            keys = self._label_keys.get(name, tuple(sorted(labels)))
+            keys = self._keys_for(name, labels)
             self._values[name][tuple(labels.get(k, "") for k in keys)] = value
 
     def inc(self, name: str, delta: float = 1.0, **labels: str) -> None:
         with self._lock:
-            keys = self._label_keys.get(name, tuple(sorted(labels)))
+            keys = self._keys_for(name, labels)
             key = tuple(labels.get(k, "") for k in keys)
             self._values[name][key] = self._values[name].get(key, 0.0) + delta
 

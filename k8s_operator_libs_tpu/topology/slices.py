@@ -26,6 +26,23 @@ GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 GKE_TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+# Multi-slice training on GKE runs under JobSet; slices whose nodes carry
+# the same jobset back one DCN data-parallel job and must never be down
+# simultaneously (BASELINE config 5).  Used as the dcn-group fallback
+# when our explicit dcn-group label is absent.  JobSet names are
+# namespace-scoped, so the fallback combines namespace/name when the
+# namespace label is present — two teams' same-named JobSets must not be
+# merged into one DCN group.
+JOBSET_NAME_LABEL = "jobset.sigs.k8s.io/jobset-name"
+JOBSET_NAMESPACE_LABEL = "jobset.sigs.k8s.io/jobset-namespace"
+
+
+def _jobset_dcn_group(labels: dict[str, str]) -> Optional[str]:
+    name = labels.get(JOBSET_NAME_LABEL)
+    if not name:
+        return None
+    ns = labels.get(JOBSET_NAMESPACE_LABEL)
+    return f"{ns}/{name}" if ns else name
 
 # Chips per host machine by GKE accelerator type (public machine shapes:
 # v4/v5p hosts carry 4 chips; v5e and v6e hosts carry up to 8 but multi-host
@@ -108,7 +125,10 @@ def slice_info_for_node(node: Node, keys: UpgradeKeys) -> Optional[SliceInfo]:
         accelerator=accelerator,
         topology=topology,
         expected_hosts=hosts_for_topology(topology, accelerator),
-        dcn_group=labels.get(keys.dcn_group_label) or None,
+        dcn_group=(
+            labels.get(keys.dcn_group_label)
+            or _jobset_dcn_group(labels)
+        ),
     )
 
 

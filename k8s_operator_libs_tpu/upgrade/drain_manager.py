@@ -25,7 +25,7 @@ from typing import Optional, Sequence
 from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import FakeCluster
-from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+from k8s_operator_libs_tpu.k8s.drain import DrainError, DrainHelper
 from k8s_operator_libs_tpu.k8s.objects import Node
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.upgrade.node_state_provider import (
@@ -120,6 +120,16 @@ class DrainManager:
     # -- worker -------------------------------------------------------------
 
     def _drain_group(self, group: UpgradeGroup, spec: DrainSpec) -> None:
+        """Drain worker with failure CLASSIFICATION.
+
+        The reference marks any drain error ``upgrade-failed``
+        (drain_manager.go:111-127) and leaves recovery to a manual
+        runbook.  Under a 2-minute downtime budget that is wrong for
+        *transient* apiserver errors: only a policy-level
+        :class:`DrainError` (undrainable pod per filters, PDB/timeout
+        exhausted) fails the slice; any other exception leaves the group
+        in ``drain-required`` so the next idempotent pass simply retries
+        the drain."""
         try:
             helper = DrainHelper(
                 self.client,
@@ -129,16 +139,19 @@ class DrainManager:
                 timeout_s=float(spec.timeout_second),
                 pod_selector=spec.pod_selector,
             )
-            failed: list[str] = []
+            policy_failed: list[str] = []
+            transient: list[str] = []
             # Phase 1: cordon every host first (no half-schedulable slice),
             # then drain hosts concurrently.
             for node in group.nodes:
                 try:
                     helper.run_cordon_or_uncordon(node, True)
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — API error: retry
                     logger.error("failed to cordon %s: %s", node.name, e)
-                    failed.append(node.name)
-            if not failed:
+                    transient.append(node.name)
+            # (Cordon errors are always transient — policy failures can
+            # only arise in the drain phase below.)
+            if not transient:
                 with ThreadPoolExecutor(
                     max_workers=min(self.max_hosts_concurrency, group.size())
                 ) as pool:
@@ -149,8 +162,10 @@ class DrainManager:
                     for fut, node in futures.items():
                         try:
                             fut.result()
-                        except Exception as e:  # noqa: BLE001
-                            logger.error("failed to drain %s: %s", node.name, e)
+                        except DrainError as e:
+                            logger.error(
+                                "failed to drain %s: %s", node.name, e
+                            )
                             log_event(
                                 self.event_recorder,
                                 node.name,
@@ -158,11 +173,28 @@ class DrainManager:
                                 self.keys.event_reason,
                                 f"Failed to drain the node, {e}",
                             )
-                            failed.append(node.name)
+                            policy_failed.append(node.name)
+                        except Exception as e:  # noqa: BLE001 — transient
+                            logger.warning(
+                                "transient error draining %s (will retry): "
+                                "%s",
+                                node.name,
+                                e,
+                            )
+                            transient.append(node.name)
 
             # Group barrier: all-or-nothing transition.
-            if failed:
+            if policy_failed:
                 self._set_group_state(group, UpgradeState.FAILED)
+            elif transient:
+                # No transition: the group stays drain-required and the
+                # next reconcile pass re-schedules the (idempotent) drain.
+                logger.info(
+                    "group %s drain will be retried next pass "
+                    "(transient errors on %s)",
+                    group.id,
+                    transient,
+                )
             else:
                 for node in group.nodes:
                     log_event(

@@ -1,0 +1,87 @@
+"""Ring attention (context parallelism) numerics + the deep ICI probe."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from k8s_operator_libs_tpu.health import ici_ring_attention_probe
+from k8s_operator_libs_tpu.health.probes import run_host_probe
+from k8s_operator_libs_tpu.workloads.ring_attention import (
+    full_attention_reference,
+    make_ring_attention,
+    ring_attention_soak,
+)
+
+
+def _qkv(rng, batch, seq, heads, dim):
+    shape = (batch, seq, heads, dim)
+    return [
+        jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        for _ in range(3)
+    ]
+
+
+def test_causal_matches_full_attention(cpu_devices):
+    mesh = Mesh(np.asarray(cpu_devices), ("sp",))
+    fn, shard = make_ring_attention(mesh, "sp", causal=True)
+    q, k, v = _qkv(np.random.default_rng(0), 2, 8 * 16, 2, 16)
+    out = fn(shard(q), shard(k), shard(v))
+    ref = full_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=5e-2
+    )
+
+
+def test_noncausal_matches_full_attention(cpu_devices):
+    mesh = Mesh(np.asarray(cpu_devices[:4]), ("sp",))
+    fn, shard = make_ring_attention(mesh, "sp", causal=False)
+    q, k, v = _qkv(np.random.default_rng(1), 1, 4 * 16, 2, 16)
+    out = fn(shard(q), shard(k), shard(v))
+    ref = full_attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=5e-2
+    )
+
+
+def test_causality_no_leakage(cpu_devices):
+    """Changing a future key/value must not change earlier outputs —
+    block-level causal masking across ring ranks is exact."""
+    mesh = Mesh(np.asarray(cpu_devices[:4]), ("sp",))
+    fn, shard = make_ring_attention(mesh, "sp", causal=True)
+    q, k, v = _qkv(np.random.default_rng(2), 1, 4 * 8, 2, 8)
+    out1 = np.asarray(fn(shard(q), shard(k), shard(v)))
+    # Perturb the LAST position's k/v (held by the last ring rank).
+    k2 = k.at[:, -1].set(100.0)
+    v2 = v.at[:, -1].set(-100.0)
+    out2 = np.asarray(fn(shard(q), shard(k2), shard(v2)))
+    np.testing.assert_array_equal(out1[:, :-1], out2[:, :-1])
+    assert not np.array_equal(out1[:, -1], out2[:, -1])
+
+
+def test_soak_reports_link_traffic(cpu_devices):
+    res = ring_attention_soak(
+        cpu_devices, seq_per_device=16, batch=1, heads=2, head_dim=8
+    )
+    assert res["ok"], res
+    assert res["global_seq"] == 16 * 8
+    assert res["moved_bytes"] > 0
+
+
+def test_deep_probe_in_battery(cpu_devices):
+    checks = run_host_probe(
+        cpu_devices, matmul_n=64, hbm_mib=1, allreduce_elems=64, deep=True
+    )
+    names = [c.name for c in checks]
+    assert names[-1] == "ici_ring_attention"
+    deep = checks[-1]
+    assert deep.ok, deep.detail
+    assert deep.metrics["devices"] == 8.0
+
+
+def test_deep_probe_single_device_vacuous(cpu_devices):
+    res = ici_ring_attention_probe(cpu_devices[:1])
+    assert res.ok
+    assert "single device" in res.detail

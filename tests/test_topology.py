@@ -1,0 +1,135 @@
+"""Slice-topology model: discovery from node labels, shape math, DCN
+(JobSet) grouping."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.topology.slices import (
+    JOBSET_NAME_LABEL,
+    SliceInfo,
+    chips_for_topology,
+    discover_slices,
+    hosts_for_topology,
+    parse_topology,
+    slice_info_for_node,
+)
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+from tests.fixtures import make_node
+
+KEYS = UpgradeKeys()
+
+GKE = {
+    "acc": "cloud.google.com/gke-tpu-accelerator",
+    "topo": "cloud.google.com/gke-tpu-topology",
+    "wid": "cloud.google.com/gke-tpu-worker-id",
+    "pool": "cloud.google.com/gke-nodepool",
+}
+
+
+def test_parse_topology():
+    assert parse_topology("2x2x4") == (2, 2, 4)
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("") == ()
+    for bad in ("2x", "x2", "2x0x4", "axb"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_chips_and_hosts():
+    assert chips_for_topology("2x2x4") == 16
+    assert hosts_for_topology("2x2x4", "tpu-v5p-slice") == 4
+    assert hosts_for_topology("4x4x4", "tpu-v5p-slice") == 16
+    # single-host v5e: 8 chips on one host
+    assert hosts_for_topology("2x4", "tpu-v5-lite-device") == 1
+    # no topology -> single host
+    assert hosts_for_topology("", "tpu-v5p-slice") == 1
+
+
+def test_slice_info_from_gke_labels():
+    node = make_node(
+        "n0",
+        labels={
+            GKE["acc"]: "tpu-v5p-slice",
+            GKE["topo"]: "2x2x4",
+            GKE["wid"]: "2",
+            GKE["pool"]: "pool-a",
+        },
+    )
+    info = slice_info_for_node(node, KEYS)
+    assert info.slice_id == "pool-a"
+    assert info.expected_hosts == 4
+    assert info.chips == 16
+    assert info.is_multi_host()
+    assert info.dcn_group is None
+
+
+def test_explicit_slice_id_wins_over_nodepool():
+    node = make_node(
+        "n0",
+        labels={
+            GKE["acc"]: "tpu-v5p-slice",
+            GKE["pool"]: "pool-a",
+            KEYS.slice_id_label: "custom-slice",
+        },
+    )
+    assert slice_info_for_node(node, KEYS).slice_id == "custom-slice"
+
+
+def test_non_tpu_node_is_none():
+    assert slice_info_for_node(make_node("plain"), KEYS) is None
+    # Node pool label alone (no accelerator/topology) is not a TPU slice.
+    assert (
+        slice_info_for_node(
+            make_node("n", labels={GKE["pool"]: "cpu-pool"}), KEYS
+        )
+        is None
+    )
+
+
+def test_dcn_group_from_jobset_label():
+    node = make_node(
+        "n0",
+        labels={
+            GKE["acc"]: "tpu-v5p-slice",
+            GKE["topo"]: "4x4x4",
+            GKE["pool"]: "pool-a",
+            JOBSET_NAME_LABEL: "llama3-pretrain",
+        },
+    )
+    assert slice_info_for_node(node, KEYS).dcn_group == "llama3-pretrain"
+    # JobSet names are namespace-scoped: the namespace label disambiguates.
+    node.labels["jobset.sigs.k8s.io/jobset-namespace"] = "team-a"
+    assert (
+        slice_info_for_node(node, KEYS).dcn_group == "team-a/llama3-pretrain"
+    )
+    # Explicit dcn-group label wins over the JobSet fallback.
+    node.labels[KEYS.dcn_group_label] = "explicit"
+    assert slice_info_for_node(node, KEYS).dcn_group == "explicit"
+
+
+def test_discover_slices_orders_by_worker_id():
+    nodes = [
+        make_node(
+            f"h{i}",
+            labels={
+                GKE["acc"]: "tpu-v5p-slice",
+                GKE["topo"]: "2x2x4",
+                GKE["wid"]: str(wid),
+                GKE["pool"]: "pool-a",
+            },
+        )
+        for i, wid in enumerate([3, 0, 2, 1])
+    ]
+    nodes.append(make_node("plain"))
+    infos, members = discover_slices(nodes, KEYS)
+    assert set(infos) == {"pool-a"}
+    assert [n.labels[GKE["wid"]] for n in members["pool-a"]] == [
+        "0", "1", "2", "3",
+    ]
+
+
+def test_slice_info_chips_fallback():
+    # No topology string: chips falls back to hosts * 4.
+    info = SliceInfo(slice_id="s", expected_hosts=4)
+    assert info.chips == 16
