@@ -38,7 +38,7 @@ sys.path.insert(0, _ROOT)
 sys.path.insert(0, os.path.join(_ROOT, "tests"))
 
 from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
-from k8s_operator_libs_tpu.health import LocalDeviceProber
+from k8s_operator_libs_tpu.health import NodeReportProber
 from k8s_operator_libs_tpu.k8s import FakeCluster, NotFoundError
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
@@ -79,12 +79,15 @@ def main() -> None:
     mgr = ClusterUpgradeStateManager(
         cluster, keys=keys, poll_interval_s=0.02, poll_timeout_s=5.0
     )
-    # Real probes on the real accelerator gate every slice.
-    prober = LocalDeviceProber(
-        devices=devices,
-        matmul_n=1024,
-        hbm_mib=64,
-        allreduce_elems=1 << 16,
+    # Production architecture: per-host agents probe the real accelerator
+    # asynchronously and publish report annotations; the controller's
+    # validation gate only reads+aggregates them (NodeReportProber), so
+    # probe latency never sits inside the reconcile tick.
+    prober = NodeReportProber(
+        keys,
+        revision_resolver=(
+            mgr.pod_manager.get_daemonset_controller_revision_hash
+        ),
     )
     mgr.with_validation_enabled(prober)
     policy = TPUUpgradePolicySpec(
@@ -122,6 +125,35 @@ def main() -> None:
 
     pool0 = [n.name for n in slices[0]]
     stop = threading.Event()
+
+    # -- per-host probe agents (one thread standing in for 16 DaemonSet
+    # pods; the probe battery runs on the real accelerator) --------------
+    def agent_loop() -> None:
+        from k8s_operator_libs_tpu.health.agent import HealthAgent
+
+        agents = [
+            HealthAgent(
+                cluster,
+                n.name,
+                keys,
+                driver_revision="v2",
+                devices=devices,
+                matmul_n=1024,
+                hbm_mib=64,
+                allreduce_elems=1 << 16,
+            )
+            for nodes in slices
+            for n in nodes
+        ]
+        while not stop.is_set():
+            report = agents[0].probe_once()  # one real battery per sweep
+            for agent in agents:
+                report.node_name = agent.node_name
+                agent.publish(report)
+            time.sleep(0.05)
+
+    agent_thread = threading.Thread(target=agent_loop, daemon=True)
+    agent_thread.start()
 
     def pool0_disrupted() -> bool:
         try:
@@ -172,6 +204,7 @@ def main() -> None:
     wall_s = time.monotonic() - t0
     stop.set()
     canary_thread.join(5.0)
+    agent_thread.join(10.0)
 
     if not done:
         log(f"UPGRADE DID NOT COMPLETE in {wall_s:.1f}s")
