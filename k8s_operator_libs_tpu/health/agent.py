@@ -102,11 +102,16 @@ class HealthAgent:
             allreduce_elems=self.allreduce_elems,
             deep=self.deep,
         )
-        devs = (
-            len(self.devices)
-            if self.devices is not None
-            else len(jax.devices())
-        )
+        # Derive the visible-device count from the enumeration check
+        # rather than re-calling jax.devices(): when libtpu is broken (the
+        # exact failure this agent exists to report) re-enumeration raises
+        # and the unhealthy report would never be published — the
+        # controller would only see staleness, losing attribution.
+        devs = 0
+        for check in checks:
+            if check.name == "device_enumeration":
+                devs = int(check.metrics.get("devices", 0.0))
+                break
         return HealthReport(
             node_name=self.node_name,
             driver_revision=self.driver_revision,

@@ -65,7 +65,8 @@ class DrainHelper:
         pod_selector: str = "",
         additional_filters: Optional[list[PodFilter]] = None,
         on_pod_deleted: Optional[Callable[[Pod, bool], None]] = None,
-        poll_interval_s: float = 0.01,
+        poll_interval_s: float = 1.0,
+        eviction_retry_interval_s: Optional[float] = None,
     ) -> None:
         self.client = client
         self.force = force
@@ -75,7 +76,17 @@ class DrainHelper:
         self.pod_selector = pod_selector
         self.additional_filters = additional_filters or []
         self.on_pod_deleted = on_pod_deleted
+        # Default 1 s matches the apiserver-facing cadence kubectl uses;
+        # tests override down to keep suites fast.
         self.poll_interval_s = poll_interval_s
+        # PDB-blocked evictions back off harder than plain deletion polls
+        # (kubectl waits ~5 s between eviction retries); scaling from the
+        # poll interval keeps test overrides proportionally fast.
+        self.eviction_retry_interval_s = (
+            eviction_retry_interval_s
+            if eviction_retry_interval_s is not None
+            else 5.0 * poll_interval_s
+        )
 
     # -- cordon ------------------------------------------------------------
 
@@ -153,11 +164,18 @@ class DrainHelper:
                     to_evict.discard(key)  # already gone
                     continue
                 except EvictionBlockedError:
-                    continue  # PDB: retry next round
+                    # PDB: retry next round, but back off — re-POSTing a
+                    # blocked eviction every poll hammers the apiserver for
+                    # no benefit (the PDB won't release that fast).
+                    backoff_s = max(backoff_s, self.eviction_retry_interval_s)
+                    continue
                 except ThrottledError as e:
                     # Apiserver asked us to back off; stop hammering it
-                    # with the rest of this round and honor Retry-After.
-                    backoff_s = max(e.retry_after_s, self.poll_interval_s)
+                    # with the rest of this round and honor Retry-After
+                    # (without shrinking a PDB backoff already owed).
+                    backoff_s = max(
+                        backoff_s, e.retry_after_s, self.poll_interval_s
+                    )
                     break
                 to_evict.discard(key)
                 if self.on_pod_deleted is not None:

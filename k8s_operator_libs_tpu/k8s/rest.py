@@ -459,6 +459,29 @@ class RestClient:
                 break
         return f"{method} {kind}"
 
+    @staticmethod
+    def _is_pdb_rejection(payload: bytes) -> bool:
+        """True when a 429 Status body names a PodDisruptionBudget cause.
+
+        The apiserver returns 429 both for PDB-blocked evictions and for
+        API priority-and-fairness throttling; only the former is a drain
+        policy signal (kubectl distinguishes the same way: Status
+        details.causes[].reason == "DisruptionBudget", with a message
+        fallback for older apiservers)."""
+        try:
+            status = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            return False
+        if not isinstance(status, dict):
+            return False
+        causes = (status.get("details") or {}).get("causes") or []
+        if any(
+            isinstance(c, dict) and c.get("reason") == "DisruptionBudget"
+            for c in causes
+        ):
+            return True
+        return "disruption budget" in str(status.get("message", "")).lower()
+
     def _request(
         self,
         method: str,
@@ -518,11 +541,13 @@ class RestClient:
         if status == 409:
             raise ConflictError(f"{method} {path}: {detail}")
         if status == 429:
-            if path.endswith("/eviction"):
+            if path.endswith("/eviction") and self._is_pdb_rejection(payload):
                 # PodDisruptionBudget rejecting the eviction; DrainHelper
                 # retries until its timeout (kubectl semantics).
                 raise EvictionBlockedError(f"{method} {path}: {detail}")
-            # Priority & fairness throttling on any other verb.
+            # Priority & fairness throttling (any verb, including an
+            # eviction POST whose Status body does not name a PDB cause):
+            # honor Retry-After instead of hammering the apiserver.
             try:
                 after = float(retry_after or 1.0)
             except ValueError:

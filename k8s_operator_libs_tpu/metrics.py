@@ -153,9 +153,14 @@ class SliceUpgradeTimer:
     def observe_state(self, state) -> None:
         # Groups arrive pre-bucketed by effective state in state.groups.
         now = time.monotonic()
+        seen: set[str] = set()
         for label, groups in state.groups.items():
+            # upgrade-failed counts as in-flight: dwell time in failed IS
+            # wall-clock the slice was disrupted, and a failed-then-
+            # recovered upgrade should report its full outage.
             in_flight = label not in ("", UpgradeState.DONE.value)
             for group in groups:
+                seen.add(group.id)
                 if in_flight and group.id not in self._started:
                     self._started[group.id] = now
                 elif not in_flight and group.id in self._started:
@@ -163,6 +168,11 @@ class SliceUpgradeTimer:
                     self.registry.set(
                         "slice_upgrade_seconds", elapsed, slice=group.id
                     )
+        # Prune groups that vanished from the snapshot (deleted node pool,
+        # relabeled slice): a long-lived controller must not leak entries,
+        # and a re-created slice id must not inherit a stale start time.
+        for gone in set(self._started) - seen:
+            del self._started[gone]
 
 
 class MetricsServer:

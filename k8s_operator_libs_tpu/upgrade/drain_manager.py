@@ -62,12 +62,17 @@ class DrainManager:
         keys: UpgradeKeys,
         event_recorder: Optional[EventRecorder] = None,
         max_hosts_concurrency: int = 32,
+        poll_interval_s: float = 1.0,
     ) -> None:
         self.client = client
         self.provider = node_state_provider
         self.keys = keys
         self.event_recorder = event_recorder
         self.max_hosts_concurrency = max_hosts_concurrency
+        # Apiserver-facing poll cadence for eviction/deletion waits; the
+        # production default (1 s, kubectl-like) is deliberately NOT the
+        # test default of the cache-sync polls — see ADVICE round 1.
+        self.poll_interval_s = poll_interval_s
         # Dedup of in-flight drains across reconcile passes
         # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
         self._draining = StringSet()
@@ -138,6 +143,7 @@ class DrainManager:
                 delete_empty_dir_data=spec.delete_empty_dir,
                 timeout_s=float(spec.timeout_second),
                 pod_selector=spec.pod_selector,
+                poll_interval_s=self.poll_interval_s,
             )
             policy_failed: list[str] = []
             transient: list[str] = []

@@ -80,6 +80,7 @@ class PodManager:
         pod_deletion_filter: Optional[PodDeletionFilter] = None,
         event_recorder: Optional[EventRecorder] = None,
         max_hosts_concurrency: int = 32,
+        poll_interval_s: float = 1.0,
     ) -> None:
         self.client = client
         self.provider = node_state_provider
@@ -87,6 +88,9 @@ class PodManager:
         self.pod_deletion_filter = pod_deletion_filter
         self.event_recorder = event_recorder
         self.max_hosts_concurrency = max_hosts_concurrency
+        # Apiserver-facing poll cadence for eviction waits (kubectl-like
+        # 1 s in production; tests pass the suite's fast interval).
+        self.poll_interval_s = poll_interval_s
         self._groups_in_progress = StringSet()  # pod_manager.go:47 analogue
         self._tracker = WorkerTracker()
 
@@ -213,6 +217,7 @@ class PodManager:
                 delete_empty_dir_data=spec.delete_empty_dir,
                 timeout_s=float(spec.timeout_second),
                 additional_filters=[self.pod_deletion_filter],
+                poll_interval_s=self.poll_interval_s,
             )
             total_to_delete = 0
             failed = False

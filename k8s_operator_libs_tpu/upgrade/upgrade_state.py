@@ -92,6 +92,7 @@ class ClusterUpgradeStateManager:
         safe_driver_load_manager: Optional[SafeDriverLoadManager] = None,
         poll_interval_s: float = 1.0,
         poll_timeout_s: float = 10.0,
+        drain_poll_interval_s: Optional[float] = None,
     ) -> None:
         self.client = client
         self.keys = keys or UpgradeKeys()
@@ -104,11 +105,20 @@ class ClusterUpgradeStateManager:
             poll_timeout_s=poll_timeout_s,
         )
         self.cordon_manager = cordon_manager or CordonManager(client)
+        # Eviction/deletion polling is a distinct cadence from the
+        # provider's cache-sync polls; it follows poll_interval_s by
+        # default (fast tests stay fast) but is independently tunable so
+        # sharpening cache-sync convergence doesn't hammer the Eviction
+        # API.
+        if drain_poll_interval_s is None:
+            drain_poll_interval_s = poll_interval_s
         self.drain_manager = drain_manager or DrainManager(
-            client, self.provider, self.keys, event_recorder
+            client, self.provider, self.keys, event_recorder,
+            poll_interval_s=drain_poll_interval_s,
         )
         self.pod_manager = pod_manager or PodManager(
-            client, self.provider, self.keys, None, event_recorder
+            client, self.provider, self.keys, None, event_recorder,
+            poll_interval_s=drain_poll_interval_s,
         )
         self.validation_manager = validation_manager or ValidationManager(
             client, self.provider, self.keys, None, event_recorder

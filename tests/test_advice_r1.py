@@ -1,0 +1,280 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. DrainManager/PodManager pass a production-sane poll interval to
+   DrainHelper, and PDB-blocked evictions back off instead of being
+   re-POSTed every 10 ms;
+2. RestClient distinguishes PDB-rejected evictions from API
+   priority-and-fairness throttling on the eviction subresource;
+3. HealthAgent publishes an unhealthy report (visible_devices=0) even when
+   device re-enumeration raises — the exact failure it exists to report;
+4. pyproject declares runtime dependencies;
+5. SliceUpgradeTimer prunes entries for groups that disappear.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_operator_libs_tpu.health.agent import HealthAgent
+from k8s_operator_libs_tpu.health.probes import CheckResult
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.client import (
+    EvictionBlockedError,
+    ThrottledError,
+)
+from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+from k8s_operator_libs_tpu.k8s.rest import KubeConfig, RestClient
+from k8s_operator_libs_tpu.metrics import MetricsRegistry, SliceUpgradeTimer
+from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+from tests.fixtures import ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+# --- 1. drain poll interval + PDB backoff -----------------------------------
+
+
+def test_drain_helper_production_defaults():
+    helper = DrainHelper(FakeCluster())
+    assert helper.poll_interval_s == 1.0
+    assert helper.eviction_retry_interval_s == 5.0
+
+
+def test_manager_plumbs_poll_interval_to_drain_and_pod_managers():
+    mgr = ClusterUpgradeStateManager(FakeCluster(), poll_interval_s=0.02)
+    assert mgr.drain_manager.poll_interval_s == 0.02
+    assert mgr.pod_manager.poll_interval_s == 0.02
+    # Production default stays kubectl-like.
+    prod = ClusterUpgradeStateManager(FakeCluster())
+    assert prod.drain_manager.poll_interval_s == 1.0
+    # The eviction cadence is independently tunable: sharpening cache-sync
+    # polls must not imply hammering the Eviction API.
+    split = ClusterUpgradeStateManager(
+        FakeCluster(), poll_interval_s=0.05, drain_poll_interval_s=1.0
+    )
+    assert split.provider.poll_interval_s == 0.05
+    assert split.drain_manager.poll_interval_s == 1.0
+    assert split.pod_manager.poll_interval_s == 1.0
+
+
+def test_blocked_eviction_backs_off():
+    """A PDB-blocked eviction must be retried at the (slower) eviction
+    retry interval, not every poll tick."""
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    node = fx.node("n1")
+    pod = fx.workload_pod(node, name="protected")
+    cluster.set_eviction_blocked(pod.namespace, pod.name)
+
+    attempts = []
+    real_evict = cluster.evict_pod
+
+    def counting_evict(ns, name):
+        attempts.append(time.monotonic())
+        return real_evict(ns, name)
+
+    cluster.evict_pod = counting_evict
+    helper = DrainHelper(
+        cluster,
+        timeout_s=0.5,
+        poll_interval_s=0.01,
+        eviction_retry_interval_s=0.1,
+    )
+    with pytest.raises(Exception, match="blocked by PDB"):
+        helper.run_node_drain("n1")
+    # 0.5 s window at 0.1 s backoff: ~5-6 attempts; the old behavior
+    # (retry every poll tick) would make ~50.
+    assert 2 <= len(attempts) <= 10, attempts
+
+
+# --- 2. eviction 429 classification over REST --------------------------------
+
+
+class _EvictionHandler(BaseHTTPRequestHandler):
+    # Per-test knob: the body/headers the stub returns for eviction POSTs.
+    status_body: dict = {}
+    retry_after: str = ""
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        payload = json.dumps(self.status_body).encode()
+        self.send_response(429)
+        if self.retry_after:
+            self.send_header("Retry-After", self.retry_after)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def eviction_client():
+    server = HTTPServer(("127.0.0.1", 0), _EvictionHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield RestClient(
+        KubeConfig(host=f"http://127.0.0.1:{server.server_port}")
+    )
+    server.shutdown()
+
+
+def test_eviction_429_with_pdb_cause_is_blocked(eviction_client):
+    _EvictionHandler.status_body = {
+        "kind": "Status",
+        "message": "Cannot evict pod as it would violate the pod's "
+        "disruption budget.",
+        "details": {"causes": [{"reason": "DisruptionBudget"}]},
+    }
+    _EvictionHandler.retry_after = ""
+    with pytest.raises(EvictionBlockedError):
+        eviction_client.evict_pod("ns", "p")
+
+
+def test_eviction_429_message_fallback_is_blocked(eviction_client):
+    """Older apiservers omit details.causes; the message names the PDB."""
+    _EvictionHandler.status_body = {
+        "kind": "Status",
+        "message": "eviction rejected: violates the pod's disruption budget",
+    }
+    with pytest.raises(EvictionBlockedError):
+        eviction_client.evict_pod("ns", "p")
+
+
+def test_eviction_429_throttle_honors_retry_after(eviction_client):
+    """A priority-and-fairness 429 on the eviction subresource is a
+    throttle, not a PDB rejection: Retry-After must be honored."""
+    _EvictionHandler.status_body = {
+        "kind": "Status",
+        "message": "Too many requests, please try again later.",
+        "reason": "TooManyRequests",
+    }
+    _EvictionHandler.retry_after = "7"
+    with pytest.raises(ThrottledError) as exc_info:
+        eviction_client.evict_pod("ns", "p")
+    assert exc_info.value.retry_after_s == 7.0
+
+
+def test_is_pdb_rejection_garbage_body():
+    assert not RestClient._is_pdb_rejection(b"<html>nope</html>")
+    assert not RestClient._is_pdb_rejection(b"")
+    assert not RestClient._is_pdb_rejection(b'"just a string"')
+
+
+# --- 3. agent publishes unhealthy report when enumeration raises -------------
+
+
+def test_agent_reports_zero_devices_when_backend_broken(monkeypatch):
+    """When libtpu is broken, run_host_probe returns a failing
+    device_enumeration check; probe_once must NOT re-enumerate (that
+    raises) and must publish visible_devices=0."""
+    import k8s_operator_libs_tpu.health.agent as agent_mod
+
+    def broken_probe(*args, **kwargs):
+        return [
+            CheckResult(
+                "device_enumeration", False, 0.0,
+                "device enumeration failed: no backend",
+            )
+        ]
+
+    monkeypatch.setattr(agent_mod, "run_host_probe", broken_probe)
+
+    def exploding_devices(*args, **kwargs):
+        raise RuntimeError("Unable to initialize backend 'tpu'")
+
+    monkeypatch.setattr(agent_mod.jax, "devices", exploding_devices)
+
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("host-0")
+    agent = HealthAgent(cluster, "host-0", KEYS, driver_revision="v2")
+    report = agent.run_once()  # must not raise
+    assert report.visible_devices == 0
+    assert not report.healthy
+    # The unhealthy report reached the node annotation (attribution kept).
+    raw = cluster.get_node("host-0", cached=False).annotations[
+        KEYS.health_report_annotation
+    ]
+    assert "device enumeration failed" in raw
+
+
+def test_agent_healthy_report_carries_device_count():
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("host-0")
+    agent = HealthAgent(
+        cluster, "host-0", KEYS, matmul_n=64, hbm_mib=1, allreduce_elems=64
+    )
+    report = agent.probe_once()
+    assert report.visible_devices >= 1
+    assert report.healthy
+
+
+# --- 4. pyproject declares runtime deps --------------------------------------
+
+
+def test_pyproject_declares_dependencies():
+    import tomllib
+
+    with open("/root/repo/pyproject.toml", "rb") as f:
+        project = tomllib.load(f)["project"]
+    deps = " ".join(project["dependencies"])
+    for pkg in ("jax", "numpy", "optax", "PyYAML"):
+        assert pkg in deps, f"{pkg} missing from [project] dependencies"
+
+
+# --- 5. SliceUpgradeTimer pruning --------------------------------------------
+
+
+class _FakeGroup:
+    def __init__(self, gid):
+        self.id = gid
+
+
+class _FakeState:
+    def __init__(self, groups):
+        self.groups = groups
+
+
+def test_slice_upgrade_timer_prunes_vanished_groups():
+    registry = MetricsRegistry()
+    timer = SliceUpgradeTimer(registry)
+    timer.observe_state(
+        _FakeState({"cordon-required": [_FakeGroup("pool-a")]})
+    )
+    assert "pool-a" in timer._started
+    # Slice vanishes from the snapshot entirely (pool deleted).
+    timer.observe_state(_FakeState({}))
+    assert timer._started == {}
+    # A re-created slice id starts a FRESH clock, not the stale one.
+    t0 = time.monotonic()
+    timer.observe_state(
+        _FakeState({"cordon-required": [_FakeGroup("pool-a")]})
+    )
+    assert timer._started["pool-a"] >= t0
+    # Completion records the fresh elapsed time.
+    timer.observe_state(_FakeState({"upgrade-done": [_FakeGroup("pool-a")]}))
+    val = registry.render()
+    assert "slice_upgrade_seconds" in val
+    assert timer._started == {}
+
+
+def test_slice_upgrade_timer_failed_dwell_counts():
+    """upgrade-failed keeps the clock running: a failed-then-recovered
+    upgrade reports its full outage wall-clock."""
+    registry = MetricsRegistry()
+    timer = SliceUpgradeTimer(registry)
+    timer.observe_state(_FakeState({"drain-required": [_FakeGroup("p")]}))
+    start = timer._started["p"]
+    timer.observe_state(_FakeState({"upgrade-failed": [_FakeGroup("p")]}))
+    assert timer._started["p"] == start  # clock uninterrupted
+    timer.observe_state(_FakeState({"upgrade-done": [_FakeGroup("p")]}))
+    assert "p" not in timer._started
