@@ -146,9 +146,16 @@ class SliceUpgradeTimer:
     """Tracks per-slice upgrade wall-clock: starts when a slice leaves
     done/unknown, stops when it returns to done — the north-star number."""
 
+    # Snapshots a group must be absent from before its in-flight entry is
+    # pruned: a mid-upgrade group can transiently vanish from ONE snapshot
+    # (its driver pod recreated and briefly unscheduled), and pruning on
+    # first miss would restart the clock and under-report the outage.
+    PRUNE_AFTER_MISSES = 3
+
     def __init__(self, registry: MetricsRegistry) -> None:
         self.registry = registry
         self._started: dict[str, float] = {}
+        self._misses: dict[str, int] = {}
 
     def observe_state(self, state) -> None:
         # Groups arrive pre-bucketed by effective state in state.groups.
@@ -168,11 +175,19 @@ class SliceUpgradeTimer:
                     self.registry.set(
                         "slice_upgrade_seconds", elapsed, slice=group.id
                     )
-        # Prune groups that vanished from the snapshot (deleted node pool,
-        # relabeled slice): a long-lived controller must not leak entries,
-        # and a re-created slice id must not inherit a stale start time.
-        for gone in set(self._started) - seen:
-            del self._started[gone]
+        # Prune groups that stay vanished from the snapshot (deleted node
+        # pool, relabeled slice): a long-lived controller must not leak
+        # entries, and a re-created slice id must not inherit a stale
+        # start time.  Absence must persist PRUNE_AFTER_MISSES snapshots —
+        # a transiently-invisible mid-upgrade group keeps its clock.
+        for gid in set(self._started) - seen:
+            self._misses[gid] = self._misses.get(gid, 0) + 1
+            if self._misses[gid] >= self.PRUNE_AFTER_MISSES:
+                del self._started[gid]
+                del self._misses[gid]
+        for gid in list(self._misses):
+            if gid in seen or gid not in self._started:
+                self._misses.pop(gid, None)
 
 
 class MetricsServer:

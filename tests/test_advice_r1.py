@@ -251,8 +251,10 @@ def test_slice_upgrade_timer_prunes_vanished_groups():
         _FakeState({"cordon-required": [_FakeGroup("pool-a")]})
     )
     assert "pool-a" in timer._started
-    # Slice vanishes from the snapshot entirely (pool deleted).
-    timer.observe_state(_FakeState({}))
+    # Slice vanishes from the snapshot entirely (pool deleted): pruned
+    # only after the absence persists.
+    for _ in range(SliceUpgradeTimer.PRUNE_AFTER_MISSES):
+        timer.observe_state(_FakeState({}))
     assert timer._started == {}
     # A re-created slice id starts a FRESH clock, not the stale one.
     t0 = time.monotonic()
@@ -265,6 +267,22 @@ def test_slice_upgrade_timer_prunes_vanished_groups():
     val = registry.render()
     assert "slice_upgrade_seconds" in val
     assert timer._started == {}
+
+
+def test_slice_upgrade_timer_transient_vanish_keeps_clock():
+    """A mid-upgrade group can be invisible for one snapshot (driver pod
+    recreated, briefly unscheduled); its clock must NOT restart."""
+    registry = MetricsRegistry()
+    timer = SliceUpgradeTimer(registry)
+    timer.observe_state(_FakeState({"drain-required": [_FakeGroup("n1")]}))
+    start = timer._started["n1"]
+    timer.observe_state(_FakeState({}))  # transient miss
+    assert timer._started["n1"] == start
+    timer.observe_state(
+        _FakeState({"pod-restart-required": [_FakeGroup("n1")]})
+    )
+    assert timer._started["n1"] == start  # miss counter reset
+    assert timer._misses == {}
 
 
 def test_slice_upgrade_timer_failed_dwell_counts():
