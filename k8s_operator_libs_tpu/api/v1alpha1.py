@@ -85,6 +85,7 @@ _JSON_NAME_OVERRIDES = {
     "drain_spec": "drain",
     # Reference upgrade_spec.go:63,77,104: TimeoutSecond -> "timeoutSeconds".
     "timeout_second": "timeoutSeconds",
+    "stuck_threshold_second": "stuckThresholdSeconds",
 }
 
 
@@ -296,9 +297,16 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
         default_factory=SliceHealthGateSpec
     )
     dcn_anti_affinity: bool = True
+    # Seconds a group may dwell in one in-progress state before the
+    # engine emits stuck-state Warning events with the progress-blocker
+    # reason (0 disables).  Distinct from the validation timeout: this is
+    # telemetry, not a transition.
+    stuck_threshold_second: int = 300
 
     def validate(self) -> None:
         super().validate()
+        if self.stuck_threshold_second < 0:
+            raise ValidationError("stuckThresholdSeconds must be >= 0")
         if self.unavailability_unit not in ("slice", "node"):
             raise ValidationError(
                 "unavailabilityUnit must be 'slice' or 'node', got "

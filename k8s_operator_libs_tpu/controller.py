@@ -98,6 +98,8 @@ class UpgradeController:
         self.registry = MetricsRegistry()
         self.metrics = UpgradeMetrics(self.registry)
         self.slice_timer = SliceUpgradeTimer(self.registry)
+        # Stuck-state dwell gauge flows into the same registry.
+        self.manager.stuck_detector.registry = self.registry
         self._stop = False
 
     def reconcile_once(self) -> bool:

@@ -70,6 +70,18 @@ class MetricsRegistry:
         with self._lock:
             self._values[name] = {}
 
+    def remove(self, name: str, **labels: str) -> None:
+        """Drop one series of a gauge (e.g. a slice that is no longer
+        stuck): the series disappears from render() instead of lingering
+        at its last value."""
+        with self._lock:
+            keys = self._label_keys.get(name)
+            if keys is None:
+                return
+            self._values[name].pop(
+                tuple(labels.get(k, "") for k in keys), None
+            )
+
     def render(self) -> str:
         with self._lock:
             lines: list[str] = []
@@ -118,6 +130,13 @@ class UpgradeMetrics:
             "slice_upgrade_seconds",
             "Wall-clock of each slice's last completed upgrade",
             "slice",
+        )
+        r.describe(
+            "slice_stuck_seconds",
+            "Dwell time of groups stuck in one in-progress state beyond "
+            "the policy threshold (0 = not stuck)",
+            "slice",
+            "state",
         )
 
     def observe(self, manager, state, duration_s: float) -> None:

@@ -45,6 +45,10 @@ from k8s_operator_libs_tpu.upgrade.validation_manager import (  # noqa: F401
 from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (  # noqa: F401
     SafeDriverLoadManager,
 )
+from k8s_operator_libs_tpu.upgrade.stuck import (  # noqa: F401
+    StuckGroup,
+    StuckStateDetector,
+)
 from k8s_operator_libs_tpu.upgrade.upgrade_state import (  # noqa: F401
     BuildStateError,
     ClusterUpgradeStateManager,

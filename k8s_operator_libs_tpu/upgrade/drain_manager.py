@@ -77,6 +77,9 @@ class DrainManager:
         # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
         self._draining = StringSet()
         self._tracker = WorkerTracker()
+        # Last drain error per group id (policy or transient), consumed by
+        # the stuck-state detector for attributable stall events.
+        self.last_error: dict[str, str] = {}
 
     def schedule_groups_drain(self, config: DrainConfiguration) -> None:
         """Schedule async drain for each group not already draining."""
@@ -191,10 +194,16 @@ class DrainManager:
 
             # Group barrier: all-or-nothing transition.
             if policy_failed:
+                self.last_error[group.id] = (
+                    f"drain policy failure on host(s) {policy_failed}"
+                )
                 self._set_group_state(group, UpgradeState.FAILED)
             elif transient:
                 # No transition: the group stays drain-required and the
                 # next reconcile pass re-schedules the (idempotent) drain.
+                self.last_error[group.id] = (
+                    f"transient drain errors on host(s) {transient}; retrying"
+                )
                 logger.info(
                     "group %s drain will be retried next pass "
                     "(transient errors on %s)",
@@ -202,6 +211,7 @@ class DrainManager:
                     transient,
                 )
             else:
+                self.last_error.pop(group.id, None)
                 for node in group.nodes:
                     log_event(
                         self.event_recorder,

@@ -1,0 +1,170 @@
+"""Stuck-state detection: loud telemetry for non-transitions.
+
+The reference events every state *transition*
+(node_upgrade_state_provider.go:123-130) but nothing ever reports a node
+that stops transitioning — operators notice a wedged upgrade by reading
+logs.  Under this framework's 2-minute downtime budget a silent stall is
+itself a failure mode, so the detector watches every in-progress group
+across reconcile passes and, when one dwells in the same state beyond a
+policy threshold, emits a Warning event per host carrying the *reason*
+progress is blocked (the validation prober's rejection, the drain
+manager's last transient error) and publishes a
+``slice_stuck_seconds{slice,state}`` gauge.
+
+The detector is deliberately read-only: it never advances or fails a
+group (the validation timeout already does that, validation_manager.py)
+— it exists to make the wait attributable while it is happening.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.upgrade.consts import (
+    IN_PROGRESS_STATES,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+    UpgradeKeys,
+    log_event,
+)
+
+logger = get_logger(__name__)
+
+# A group sitting in one in-progress state longer than this is "stuck".
+# Half the reference's 600 s validation timeout: loud well before the
+# engine gives up and fails the slice.
+DEFAULT_STUCK_THRESHOLD_S = 300.0
+# Re-emit cadence once stuck (every tick would flood the event stream).
+DEFAULT_RE_EMIT_INTERVAL_S = 60.0
+
+
+@dataclass
+class StuckGroup:
+    """One currently-stuck group, as reported by observe()."""
+
+    group_id: str
+    state: str
+    stuck_seconds: float
+    reason: str
+
+
+class StuckStateDetector:
+    """Tracks per-group state dwell time across reconcile passes."""
+
+    def __init__(
+        self,
+        keys: UpgradeKeys,
+        event_recorder: Optional[EventRecorder] = None,
+        threshold_s: float = DEFAULT_STUCK_THRESHOLD_S,
+        re_emit_interval_s: float = DEFAULT_RE_EMIT_INTERVAL_S,
+        # Anything with .set(name, value, **labels) — the metrics
+        # registry; duck-typed to avoid a package cycle.
+        registry=None,
+    ) -> None:
+        self.keys = keys
+        self.event_recorder = event_recorder
+        self.threshold_s = threshold_s
+        self.re_emit_interval_s = re_emit_interval_s
+        self.registry = registry
+        # group id -> (state value, entered-at monotonic)
+        self._entered: dict[str, tuple[str, float]] = {}
+        self._last_emit: dict[str, float] = {}
+        # group id -> state label of the gauge series last published, so
+        # the exact series can be dropped when the group moves on (a
+        # stale nonzero series would keep alerts firing forever).
+        self._published: dict[str, str] = {}
+        # group id -> last known progress blocker, supplied by the
+        # engine's sub-managers (validation rejection, drain error).
+        self._reason_sources: list[Callable[[str], Optional[str]]] = []
+
+    def add_reason_source(
+        self, source: Callable[[str], Optional[str]]
+    ) -> None:
+        """Register a ``group_id -> reason | None`` lookup (e.g. the
+        validation manager's last rejection)."""
+        self._reason_sources.append(source)
+
+    def reason_for(self, group_id: str) -> str:
+        for source in self._reason_sources:
+            reason = source(group_id)
+            if reason:
+                return reason
+        return "no progress-blocker reason recorded"
+
+    def observe(self, state, now: Optional[float] = None) -> list[StuckGroup]:
+        """One pass over the snapshot; returns currently-stuck groups.
+
+        Call after apply_state each reconcile (the state manager does
+        this automatically)."""
+        now = time.monotonic() if now is None else now
+        stuck: list[StuckGroup] = []
+        seen: set[str] = set()
+        # FAILED is excluded: a terminally failed group has already had
+        # its own loud failure event, and re-warning "stuck" per host
+        # every minute until manual intervention would flood the event
+        # stream and drown the actionable signal.
+        for st in IN_PROGRESS_STATES:
+            if st == UpgradeState.FAILED:
+                continue
+            for group in state.groups_in(st):
+                seen.add(group.id)
+                entered = self._entered.get(group.id)
+                if entered is None or entered[0] != st.value:
+                    self._entered[group.id] = (st.value, now)
+                    self._last_emit.pop(group.id, None)
+                    self._drop_series(group.id)
+                    continue
+                dwell = now - entered[1]
+                if self.threshold_s and dwell > self.threshold_s:
+                    reason = self.reason_for(group.id)
+                    stuck.append(
+                        StuckGroup(group.id, st.value, dwell, reason)
+                    )
+                    self._publish(group, st.value, dwell, reason, now)
+        # Groups that left the tracked lattice: clear tracking + gauge.
+        for gone in set(self._entered) - seen:
+            del self._entered[gone]
+            self._last_emit.pop(gone, None)
+            self._drop_series(gone)
+        return stuck
+
+    def _drop_series(self, group_id: str) -> None:
+        state_label = self._published.pop(group_id, None)
+        if state_label is not None and self.registry is not None:
+            self.registry.remove(
+                "slice_stuck_seconds", slice=group_id, state=state_label
+            )
+
+    def _publish(
+        self, group, state_value: str, dwell: float, reason: str, now: float
+    ) -> None:
+        if self.registry is not None:
+            self.registry.set(
+                "slice_stuck_seconds", dwell, slice=group.id,
+                state=state_value,
+            )
+            self._published[group.id] = state_value
+        last = self._last_emit.get(group.id)
+        if last is not None and now - last < self.re_emit_interval_s:
+            return
+        self._last_emit[group.id] = now
+        message = (
+            f"Upgrade stuck: group {group.id} has been in "
+            f"'{state_value}' for {dwell:.0f}s (threshold "
+            f"{self.threshold_s:.0f}s): {reason}"
+        )
+        logger.warning("%s", message)
+        for node in group.nodes:
+            log_event(
+                self.event_recorder,
+                node.name,
+                EVENT_TYPE_WARNING,
+                self.keys.event_reason,
+                message,
+            )

@@ -53,6 +53,7 @@ from k8s_operator_libs_tpu.upgrade.pod_manager import (
 from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (
     SafeDriverLoadManager,
 )
+from k8s_operator_libs_tpu.upgrade.stuck import StuckStateDetector
 from k8s_operator_libs_tpu.upgrade.types import (
     ClusterUpgradeState,
     NodeUpgradeState,
@@ -127,6 +128,17 @@ class ClusterUpgradeStateManager:
             safe_driver_load_manager
             or SafeDriverLoadManager(self.provider, self.keys)
         )
+        # Stuck-state telemetry: Warning events + slice_stuck_seconds when
+        # a group dwells in one in-progress state beyond the policy
+        # threshold, carrying the sub-managers' progress-blocker reasons.
+        self.stuck_detector = StuckStateDetector(self.keys, event_recorder)
+        for owner, attr in (
+            (self.validation_manager, "last_rejection"),
+            (self.drain_manager, "last_error"),
+        ):
+            reasons = getattr(owner, attr, None)  # injected fakes may lack it
+            if reasons is not None:
+                self.stuck_detector.add_reason_source(reasons.get)
         self._pod_deletion_enabled = False
         self._validation_enabled = False
 
@@ -332,6 +344,11 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_failed_groups(current_state)
         self.process_validation_required_groups(current_state, validation_active)
         self.process_uncordon_required_groups(current_state)
+        if isinstance(policy, TPUUpgradePolicySpec):
+            self.stuck_detector.threshold_s = float(
+                policy.stuck_threshold_second
+            )
+        self.stuck_detector.observe(current_state)
         logger.info("state manager finished processing")
 
     # -- processors ----------------------------------------------------------

@@ -106,6 +106,9 @@ class ValidationManager:
         self.prober = prober
         self.event_recorder = event_recorder
         self.timeout_seconds = timeout_seconds
+        # Last rejection reason per group id, consumed by the stuck-state
+        # detector so a long validation wait is attributable in events.
+        self.last_rejection: dict[str, str] = {}
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -116,8 +119,10 @@ class ValidationManager:
         result = self.prober.probe(group)
         if not result.healthy:
             logger.info("group %s validation pending: %s", group.id, result.detail)
+            self.last_rejection[group.id] = result.detail
             self._handle_timeout(group)
             return False
+        self.last_rejection.pop(group.id, None)
         # Passed: clear the start-time annotation.
         self.provider.change_nodes_upgrade_annotation(
             [
@@ -142,6 +147,9 @@ class ValidationManager:
         start = min(int(n.annotations[key]) for n in stamped)
         if self.timeout_seconds and now > start + self.timeout_seconds:
             logger.info("group %s validation timed out -> failed", group.id)
+            # The group leaves validation: a stale rejection must not be
+            # attributed to a future stall in a different phase.
+            self.last_rejection.pop(group.id, None)
             for node in group.nodes:
                 log_event(
                     self.event_recorder,

@@ -1,0 +1,275 @@
+"""Stuck-state telemetry: a group dwelling in one in-progress state past
+the policy threshold must produce loud, attributable signals (Warning
+events carrying the progress-blocker reason + slice_stuck_seconds gauge)
+without the engine forcing a transition."""
+
+from __future__ import annotations
+
+from k8s_operator_libs_tpu.api import (
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.metrics import MetricsRegistry
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    EventRecorder,
+    StuckStateDetector,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+from tests.test_upgrade_state import FakeProber
+
+KEYS = UpgradeKeys()
+
+
+def _manager(client, events):
+    return ClusterUpgradeStateManager(
+        client,
+        keys=KEYS,
+        event_recorder=events,
+        poll_interval_s=0.005,
+        poll_timeout_s=2.0,
+    )
+
+
+def _stuck_events(events):
+    return [
+        e
+        for e in events.events
+        if e.event_type == "Warning" and "stuck" in e.message.lower()
+    ]
+
+
+def test_stuck_validation_emits_reason_and_gauge():
+    """A slice wedged in validation-required (prober keeps rejecting)
+    surfaces the prober's rejection reason in a Warning event and the
+    slice_stuck_seconds gauge — the loud telemetry VERDICT asked for."""
+    c = FakeCluster()
+    events = EventRecorder()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=2, state=UpgradeState.VALIDATION_REQUIRED,
+        unschedulable=True,
+    )
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h2")
+    prober = FakeProber(
+        healthy=False, detail="host pool-a-w1: 3/4 chips enumerate"
+    )
+    mgr = _manager(c, events).with_validation_enabled(prober)
+    registry = MetricsRegistry()
+    mgr.stuck_detector.registry = registry
+    # No artificial sleeping: drive the detector clock directly.
+    mgr.stuck_detector.re_emit_interval_s = 0.0
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        stuck_threshold_second=1,
+        health_gate=SliceHealthGateSpec(timeout_second=0),  # never fail
+    )
+
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert _stuck_events(events) == []  # first pass: dwell clock starts
+
+    # Backdate the dwell start beyond the threshold, then reconcile again.
+    state_val, _ = mgr.stuck_detector._entered["pool-a"]
+    mgr.stuck_detector._entered["pool-a"] = (state_val, -10.0)
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+
+    stuck = _stuck_events(events)
+    assert len(stuck) == 2  # one Warning per host
+    assert "validation-required" in stuck[0].message
+    assert "3/4 chips enumerate" in stuck[0].message
+    # Gauge published with slice+state labels.
+    rendered = registry.render()
+    assert 'slice_stuck_seconds{slice="pool-a",state="validation-required"}' in rendered
+    # Telemetry only: the engine did NOT transition the group.
+    for n in nodes:
+        assert state_of(c, KEYS, n.name) == (
+            UpgradeState.VALIDATION_REQUIRED.value
+        )
+
+
+def test_stuck_gauge_clears_when_group_progresses():
+    c = FakeCluster()
+    events = EventRecorder()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=2, state=UpgradeState.VALIDATION_REQUIRED,
+        unschedulable=True,
+    )
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h2")
+    prober = FakeProber(healthy=False, detail="not yet")
+    mgr = _manager(c, events).with_validation_enabled(prober)
+    registry = MetricsRegistry()
+    mgr.stuck_detector.registry = registry
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True, stuck_threshold_second=1,
+        health_gate=SliceHealthGateSpec(timeout_second=0),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    state_val, _ = mgr.stuck_detector._entered["pool-a"]
+    mgr.stuck_detector._entered["pool-a"] = (state_val, -10.0)
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert 'slice_stuck_seconds{slice="pool-a"' in registry.render()
+    # The slice heals: prober passes, group completes, and the stale
+    # stuck series disappears entirely (an alert on >0 stops firing).
+    prober.healthy = True
+    for _ in range(3):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert state_of(c, KEYS, nodes[0].name) == UpgradeState.DONE.value
+    assert 'slice_stuck_seconds{slice="pool-a"' not in registry.render()
+
+
+def test_stuck_drain_reason_from_drain_manager():
+    """A drain wedged on transient apiserver errors attributes the stall
+    to the drain manager's recorded error."""
+    c = FakeCluster()
+    events = EventRecorder()
+    mgr = _manager(c, events)
+    mgr.drain_manager.last_error["pool-a"] = (
+        "transient drain errors on host(s) ['pool-a-w0']; retrying"
+    )
+    assert "transient drain errors" in mgr.stuck_detector.reason_for("pool-a")
+    assert (
+        mgr.stuck_detector.reason_for("pool-b")
+        == "no progress-blocker reason recorded"
+    )
+
+
+def test_stuck_re_emit_throttled():
+    """Once stuck, events re-emit at re_emit_interval_s, not every tick."""
+    c = FakeCluster()
+    events = EventRecorder()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=1, state=UpgradeState.VALIDATION_REQUIRED,
+        unschedulable=True,
+    )
+    fx.driver_pod(nodes[0], ds, hash_suffix="h2")
+    mgr = _manager(c, events).with_validation_enabled(
+        FakeProber(healthy=False, detail="nope")
+    )
+    mgr.stuck_detector.re_emit_interval_s = 3600.0
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True, stuck_threshold_second=1,
+        health_gate=SliceHealthGateSpec(timeout_second=0),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    state_val, _ = mgr.stuck_detector._entered["pool-a"]
+    mgr.stuck_detector._entered["pool-a"] = (state_val, -10.0)
+    for _ in range(4):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert len(_stuck_events(events)) == 1  # throttled to one emission
+
+
+def test_failed_groups_do_not_emit_stuck_events():
+    """upgrade-failed already has its own loud failure path; the stuck
+    detector must not flood the event stream re-warning about it."""
+    c = FakeCluster()
+    events = EventRecorder()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=2, state=UpgradeState.FAILED, unschedulable=True
+    )
+    for n in nodes:
+        # Old-revision pod: the group stays failed (never back in sync).
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    mgr = _manager(c, events)
+    mgr.stuck_detector.re_emit_interval_s = 0.0
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True, stuck_threshold_second=1
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    # Even with a long-backdated clock the FAILED state is not tracked.
+    assert "pool-a" not in mgr.stuck_detector._entered
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert _stuck_events(events) == []
+
+
+def test_stuck_series_dropped_on_state_transition():
+    """A group that moves from stuck state A to state B must not leave
+    the state-A gauge series lingering at its last nonzero value."""
+    from k8s_operator_libs_tpu.metrics import MetricsRegistry as _Reg
+
+    class G:
+        def __init__(self, gid):
+            self.id = gid
+            self.nodes = []
+
+    class S:
+        def __init__(self, bucket):
+            self._bucket = bucket
+
+        def groups_in(self, st):
+            return self._bucket.get(st.value, [])
+
+    reg = _Reg()
+    det = StuckStateDetector(KEYS, threshold_s=5.0, registry=reg)
+    g = G("pool-x")
+    det.observe(S({"drain-required": [g]}), now=0.0)
+    det.observe(S({"drain-required": [g]}), now=10.0)  # stuck, published
+    assert 'state="drain-required"' in reg.render()
+    det.observe(S({"pod-restart-required": [g]}), now=11.0)  # transition
+    assert 'state="drain-required"' not in reg.render()
+
+
+def test_validation_timeout_clears_last_rejection():
+    """Timeout->FAILED must clear the stored rejection so a later stall
+    in another phase is not mis-attributed to it."""
+    import time as _time
+
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    old = str(int(_time.time()) - 100)
+    n = fx.node(
+        state=UpgradeState.VALIDATION_REQUIRED,
+        annotations={KEYS.validation_start_time_annotation: old},
+    )
+    fx.driver_pod(n, None)
+    mgr = _manager(c, EventRecorder()).with_validation_enabled(
+        FakeProber(healthy=False, detail="3/4 chips")
+    )
+    mgr.apply_state(
+        mgr.build_state(NAMESPACE, DRIVER_LABELS),
+        TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            health_gate=SliceHealthGateSpec(timeout_second=30),
+        ),
+    )
+    assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+    assert mgr.validation_manager.last_rejection == {}
+
+
+def test_detector_standalone_observe_resets_on_transition():
+    """State changes reset the dwell clock (per-state, not per-upgrade)."""
+
+    class G:
+        def __init__(self, gid):
+            self.id = gid
+            self.nodes = []
+
+    class S:
+        def __init__(self, bucket):
+            self._bucket = bucket
+
+        def groups_in(self, st):
+            return self._bucket.get(st.value, [])
+
+    det = StuckStateDetector(KEYS, threshold_s=5.0)
+    g = G("pool-x")
+    assert det.observe(S({"drain-required": [g]}), now=0.0) == []
+    # 4s dwell: under threshold.
+    assert det.observe(S({"drain-required": [g]}), now=4.0) == []
+    # Transition: clock resets; 4s in the NEW state is not stuck.
+    assert det.observe(S({"pod-restart-required": [g]}), now=6.0) == []
+    assert det.observe(S({"pod-restart-required": [g]}), now=10.0) == []
+    stuck = det.observe(S({"pod-restart-required": [g]}), now=12.5)
+    assert [s.group_id for s in stuck] == ["pool-x"]
+    assert stuck[0].stuck_seconds == 6.5

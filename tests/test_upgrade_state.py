@@ -46,13 +46,14 @@ def auto_policy(**kw) -> DriverUpgradePolicySpec:
 
 
 class FakeProber:
-    def __init__(self, healthy=True):
+    def __init__(self, healthy=True, detail="fake"):
         self.healthy = healthy
+        self.detail = detail
         self.calls = 0
 
     def probe(self, group):
         self.calls += 1
-        return ProbeResult(self.healthy, "fake")
+        return ProbeResult(self.healthy, self.detail)
 
 
 class TestBuildState:
