@@ -78,8 +78,8 @@ class HealthAgent:
         driver_revision: str = "",
         devices: Optional[Sequence[jax.Device]] = None,
         slice_wide: bool = False,
-        matmul_n: int = 2048,
-        hbm_mib: int = 256,
+        matmul_n: int = 4096,
+        hbm_mib: int = 1024,
         allreduce_elems: int = 1 << 20,
         deep: bool = False,
     ) -> None:

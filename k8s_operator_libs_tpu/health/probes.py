@@ -86,6 +86,94 @@ def _timed(fn, *args) -> tuple[float, object]:
     return (time.perf_counter() - t0) * 1e3, out
 
 
+# Sustained measurement window.  A single-dispatch timing is dominated by
+# host->device dispatch/round-trip cost and can read orders of magnitude
+# off the hardware's real throughput (low when a fixed round trip
+# dominates a small op; absurdly HIGH when the runtime's
+# block_until_ready does not actually wait, as on tunneled remote
+# backends) — either way useless for threshold policies.
+DEFAULT_MIN_TIME_S = 0.05
+_MAX_SUSTAINED_ITERS = 256
+
+
+def _sync_readback(out) -> None:
+    """Force execution by reading one element back to the host.
+
+    ``block_until_ready`` is not trustworthy on every backend (remote
+    tunnels ack the enqueue, not the execution); a host readback cannot
+    complete without the producing computation."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if getattr(leaf, "ndim", 0):
+        np.asarray(leaf[(slice(0, 1),) * leaf.ndim])
+    else:
+        np.asarray(leaf)
+
+
+def _timed_sustained(
+    fn,
+    args: tuple,
+    min_time_s: float = DEFAULT_MIN_TIME_S,
+    chain: bool = False,
+    max_iters: int = _MAX_SUSTAINED_ITERS,
+    flush_every: int = 0,
+) -> tuple[float, object, int]:
+    """(per-iteration latency ms, last output, chained iterations).
+
+    Measures *sustained* per-op time as the slope between two loop
+    lengths: run k1 iterations (one readback sync), run 4·k1 iterations
+    (one readback sync), and divide the time difference by the iteration
+    difference — any fixed cost (compile residue, dispatch round trip,
+    readback) appears in both runs and cancels exactly, leaving pipelined
+    device throughput.  ``chain=True`` feeds each output back as the
+    first argument so every iteration depends on the previous one and
+    nothing can be elided; the returned iteration count is the total
+    number of applications on the chained value (for analytic content
+    checks)."""
+    state = {"out": None, "applied": 0}
+
+    def run(iters: int, start) -> float:
+        cur = start
+        out = None
+        t0 = time.perf_counter()
+        for i in range(iters):
+            out = fn(*cur)
+            if chain:
+                cur = (out, *args[1:])
+            # flush_every bounds the in-flight queue where that matters
+            # (hundreds of un-synced multi-device executions exhaust
+            # host-backend resources).  It is 0 for single-device probes:
+            # chained ops keep only two buffers live, and every flush
+            # costs a full round trip on remote backends — throttling
+            # the very throughput being measured.
+            if flush_every and (i + 1) % flush_every == 0:
+                jax.block_until_ready(out)
+        _sync_readback(out)
+        elapsed = time.perf_counter() - t0
+        state["out"] = out
+        state["applied"] += iters
+        return elapsed
+
+    def start_args():
+        return (state["out"], *args[1:]) if chain else args
+
+    # Warm/compile.
+    state["out"] = fn(*args)
+    _sync_readback(state["out"])
+    state["applied"] = 1
+    # Pilot run to size k1 so the short run holds >= min_time_s of work.
+    # Floor at 16: remote backends only reach pipelined throughput past
+    # ~16 queued ops (shallow queues pay a round trip per op, which the
+    # slope would then faithfully — but uselessly — report).
+    pilot_s = run(2, start_args())
+    per_est = max(pilot_s / 2, 1e-7)
+    k1 = max(16, min(max_iters // 4, int(min_time_s / per_est) + 1))
+    k2 = 4 * k1
+    t1 = run(k1, start_args())
+    t2 = run(k2, start_args())
+    per_s = max((t2 - t1) / (k2 - k1), 1e-9)
+    return per_s * 1e3, state["out"], state["applied"]
+
+
 def device_inventory(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
@@ -119,51 +207,88 @@ def device_inventory(
 
 
 def matmul_probe(
-    device: Optional[jax.Device] = None, n: int = 2048, dtype=jnp.bfloat16
+    device: Optional[jax.Device] = None,
+    n: int = 4096,
+    dtype=jnp.bfloat16,
+    min_time_s: float = DEFAULT_MIN_TIME_S,
 ) -> CheckResult:
-    """MXU correctness + throughput: ``C = A @ B`` with an analytic result.
+    """MXU correctness + sustained throughput with an analytic result.
 
-    A is filled with ``a``, B with ``b`` ⇒ every C element equals
-    ``n*a*b`` exactly (bf16 operands are exact for these small constants
-    and accumulation is forced to f32 via ``preferred_element_type``), so
-    any deviation is a real compute fault, not rounding."""
+    A is filled with ``0.5`` and B with ``1/n`` ⇒ every element of
+    ``A @ B`` equals ``n * 0.5 * (1/n) = 0.5`` exactly (for power-of-two
+    ``n`` both constants are exact in bf16 and accumulation is forced to
+    f32), so the product can be *chained* — ``C ← C @ B`` keeps every
+    value at exactly 0.5 — giving a dependent back-to-back matmul stream
+    whose per-iteration time is real MXU throughput, and any deviation
+    anywhere in the chain is a compute fault, not rounding.  Reports
+    sustained TFLOPS and MFU against the chip's spec."""
+    if n & (n - 1):
+        # A failing check, not an exception: run_host_probe's contract is
+        # that every probe yields an attributable CheckResult, and a
+        # misconfigured battery must still publish a report.
+        return CheckResult(
+            "mxu_matmul", False, 0.0,
+            f"matmul_probe needs power-of-two n for exact chained "
+            f"verification, got {n}",
+        )
     if device is None:
         device = jax.devices()[0]
-    a_val, b_val = 0.5, 0.25
-    expected = n * a_val * b_val
+    a_val, b_val = 0.5, 1.0 / n
+    expected = np.float32(a_val)  # invariant under each chained matmul
 
     @jax.jit
-    def mm(a, b):
-        return jnp.matmul(a, b, preferred_element_type=jnp.float32)
+    def mm(c, b):
+        return jnp.matmul(
+            c, b, preferred_element_type=jnp.float32
+        ).astype(dtype)
 
     try:
         a = jax.device_put(jnp.full((n, n), a_val, dtype=dtype), device)
         b = jax.device_put(jnp.full((n, n), b_val, dtype=dtype), device)
-        latency_ms, out = _timed(mm, a, b)
-        got = np.asarray(out)
+        latency_ms, out, iters = _timed_sustained(
+            mm, (a, b), min_time_s=min_time_s, chain=True
+        )
+        got = np.asarray(out).astype(np.float32)
     except Exception as e:  # noqa: BLE001 — any device fault fails the check
         return CheckResult("mxu_matmul", False, 0.0, f"matmul failed: {e}")
     exact = bool(np.all(got == expected))
     tflops = (2.0 * n * n * n) / (latency_ms * 1e-3) / 1e12
+    from k8s_operator_libs_tpu.hw import mfu as _mfu
+
+    metrics = {"tflops": tflops, "n": float(n), "iters": float(iters)}
+    mfu_frac = _mfu(tflops, device.device_kind)
+    if mfu_frac is not None:
+        metrics["mfu"] = mfu_frac
     return CheckResult(
         "mxu_matmul",
         exact,
         latency_ms,
-        "exact" if exact else
-        f"matmul result mismatch: expected {expected}, got "
-        f"[{got.min()}, {got.max()}]",
-        {"tflops": tflops, "n": float(n)},
+        (
+            f"exact; {tflops:.1f} TFLOPS sustained over {iters} chained "
+            f"matmuls (n={n})"
+        )
+        if exact
+        else (
+            f"matmul result mismatch: expected {expected}, got "
+            f"[{got.min()}, {got.max()}]"
+        ),
+        metrics,
     )
 
 
 def hbm_bandwidth_probe(
-    device: Optional[jax.Device] = None, mib: int = 256
+    device: Optional[jax.Device] = None,
+    mib: int = 1024,
+    min_time_s: float = DEFAULT_MIN_TIME_S,
 ) -> CheckResult:
-    """Streaming HBM read+write: ``y = x + 1`` over a ``mib``-MiB f32 array.
+    """Sustained HBM stream: chained ``x ← x + 1`` over a ``mib``-MiB f32
+    array (default 1 GiB — large enough that one pass is pure HBM
+    traffic, not cache).
 
-    Catches the silently-degraded-HBM failure mode.  The check itself
-    verifies the add (content check on a sample), the bandwidth figure is
-    surfaced as a metric for threshold policies in the prober."""
+    Catches the silently-degraded-HBM failure mode.  Chaining makes every
+    iteration depend on the previous one's memory, so XLA cannot elide
+    work, and the final value is the exact iteration count — a content
+    check over the whole accumulation, not a single add."""
     if device is None:
         device = jax.devices()[0]
     elems = (mib * 1024 * 1024) // 4
@@ -174,19 +299,29 @@ def hbm_bandwidth_probe(
 
     try:
         x = jax.device_put(jnp.zeros((elems,), jnp.float32), device)
-        latency_ms, out = _timed(stream, x)
+        latency_ms, out, iters = _timed_sustained(
+            stream, (x,), min_time_s=min_time_s, chain=True
+        )
         sample = np.asarray(out[:8])
     except Exception as e:  # noqa: BLE001
         return CheckResult("hbm_bandwidth", False, 0.0, f"stream failed: {e}")
-    ok = bool(np.all(sample == 1.0))
-    nbytes = elems * 4 * 2  # read + write
+    # The chained value accumulates exactly one add per application,
+    # starting from zeros; `iters` is the total application count.
+    expected = float(iters)
+    ok = bool(np.all(sample == expected))
+    nbytes = elems * 4 * 2  # read + write per iteration
     gbps = nbytes / (latency_ms * 1e-3) / 1e9
     return CheckResult(
         "hbm_bandwidth",
         ok,
         latency_ms,
-        f"{gbps:.1f} GB/s over {mib} MiB" if ok else "stream content mismatch",
-        {"gbps": gbps, "mib": float(mib)},
+        f"{gbps:.1f} GB/s sustained over {mib} MiB x {iters} passes"
+        if ok
+        else (
+            f"stream content mismatch: expected {expected}, got "
+            f"{sample[:4]}"
+        ),
+        {"gbps": gbps, "mib": float(mib), "iters": float(iters)},
     )
 
 
@@ -197,13 +332,16 @@ def _make_ici_mesh(devices: Sequence[jax.Device]) -> Mesh:
 def ici_allreduce_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     per_device_elems: int = 1 << 20,
+    min_time_s: float = DEFAULT_MIN_TIME_S,
 ) -> CheckResult:
     """All-reduce (`psum`) across every chip of the slice mesh.
 
     Device ``i`` contributes the constant ``i+1`` ⇒ every shard of the
     result must equal ``n(n+1)/2`` exactly.  Success means the torus
     re-formed end-to-end — the north-star "100 % slice re-formation"
-    predicate.  Also reports ring-all-reduce bus bandwidth."""
+    predicate.  Bus bandwidth is measured over a sustained run (the same
+    input re-reduced back to back), so the figure reflects link
+    throughput, not dispatch latency."""
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
     if n < 2:
@@ -230,7 +368,9 @@ def ici_allreduce_probe(
             axis=1,
         )
         x = jax.device_put(host, NamedSharding(mesh, P(ICI_AXIS)))
-        latency_ms, out = _timed(fn, x)
+        latency_ms, out, iters = _timed_sustained(
+            fn, (x,), min_time_s=min_time_s, flush_every=16
+        )
         got = np.asarray(out)
     except Exception as e:  # noqa: BLE001
         return CheckResult(
@@ -244,10 +384,14 @@ def ici_allreduce_probe(
         "ici_allreduce",
         exact,
         latency_ms,
-        f"psum over {n} devices exact" if exact else
-        f"psum mismatch: expected {expected}, got "
+        (
+            f"psum over {n} devices exact; {busbw:.1f} GB/s bus bandwidth "
+            f"sustained over {iters} rounds"
+        )
+        if exact
+        else f"psum mismatch: expected {expected}, got "
         f"[{got.min()}, {got.max()}]",
-        {"devices": float(n), "busbw_gbps": busbw},
+        {"devices": float(n), "busbw_gbps": busbw, "iters": float(iters)},
     )
 
 
@@ -356,13 +500,19 @@ def ici_ring_attention_probe(
 def run_host_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
-    matmul_n: int = 2048,
-    hbm_mib: int = 256,
+    matmul_n: int = 4096,
+    hbm_mib: int = 1024,
     allreduce_elems: int = 1 << 20,
     skip_ici: bool = False,
     deep: bool = False,
+    min_time_s: float = DEFAULT_MIN_TIME_S,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
+
+    Production defaults are sized for *sustained* measurement (n=4096
+    matmuls, 1 GiB HBM stream, ≥50 ms device time per probe) so the
+    reported TFLOPS/GB/s figures are comparable to chip spec and usable
+    as health floors; tests/CI pass small overrides.
 
     Fail-fast on enumeration (nothing else can run without devices), then
     run every remaining probe even if one fails — the per-check results
@@ -386,11 +536,15 @@ def run_host_probe(
     # device_put onto a non-addressable device raises.
     local = [d for d in devs if d.process_index == jax.process_index()]
     probe_dev = local[0] if local else devs[0]
-    results.append(matmul_probe(probe_dev, n=matmul_n))
-    results.append(hbm_bandwidth_probe(probe_dev, mib=hbm_mib))
+    results.append(matmul_probe(probe_dev, n=matmul_n, min_time_s=min_time_s))
+    results.append(
+        hbm_bandwidth_probe(probe_dev, mib=hbm_mib, min_time_s=min_time_s)
+    )
     if not skip_ici:
         results.append(
-            ici_allreduce_probe(devs, per_device_elems=allreduce_elems)
+            ici_allreduce_probe(
+                devs, per_device_elems=allreduce_elems, min_time_s=min_time_s
+            )
         )
         results.append(ici_ring_probe(devs))
         if deep:

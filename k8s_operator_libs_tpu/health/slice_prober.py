@@ -48,8 +48,8 @@ class LocalDeviceProber:
         self,
         devices: Optional[Sequence[jax.Device]] = None,
         expected_devices: int = 0,
-        matmul_n: int = 2048,
-        hbm_mib: int = 256,
+        matmul_n: int = 4096,
+        hbm_mib: int = 1024,
         allreduce_elems: int = 1 << 20,
     ) -> None:
         self.devices = list(devices) if devices is not None else None

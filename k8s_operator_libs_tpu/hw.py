@@ -1,0 +1,75 @@
+"""Public TPU chip specs, for MFU math and health-floor derivation.
+
+Numbers are the published per-chip peaks (Google Cloud TPU system
+architecture docs): dense bf16 TFLOPS and HBM bandwidth.  They are used
+two ways:
+
+- **MFU**: canary tokens/s → model FLOPs utilisation against the chip's
+  peak, the honest throughput metric (scaling-book convention);
+- **health floors**: a sustained probe reading far below spec on a chip
+  that enumerates fine is the silent-degradation failure mode the HBM
+  probe exists to catch; floors default to a conservative fraction of
+  spec (or of a measured healthy baseline).
+
+``device_kind`` strings come from ``jax.Device.device_kind`` (e.g.
+``"TPU v5 lite"``, ``"TPU v4"``); matching is substring-based and
+case-insensitive, unknown kinds (CPU test meshes) yield None so callers
+skip spec-relative checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float
+    hbm_gbps: float
+    hbm_gib: float
+
+
+# Substring (lowercased) -> spec.  Order matters: more specific first.
+_CHIP_SPECS: list[tuple[str, ChipSpec]] = [
+    ("v5 lite", ChipSpec("v5e", 197.0, 819.0, 16.0)),
+    ("v5litepod", ChipSpec("v5e", 197.0, 819.0, 16.0)),
+    ("v5e", ChipSpec("v5e", 197.0, 819.0, 16.0)),
+    ("v5p", ChipSpec("v5p", 459.0, 2765.0, 95.0)),
+    ("v6 lite", ChipSpec("v6e", 918.0, 1640.0, 32.0)),
+    ("v6e", ChipSpec("v6e", 918.0, 1640.0, 32.0)),
+    ("v4", ChipSpec("v4", 275.0, 1228.0, 32.0)),
+    ("v3", ChipSpec("v3", 123.0, 900.0, 16.0)),
+    ("v2", ChipSpec("v2", 45.0, 700.0, 8.0)),
+]
+
+
+def chip_spec(device_kind: str) -> Optional[ChipSpec]:
+    """Spec for a ``jax.Device.device_kind`` string, or None if unknown."""
+    kind = (device_kind or "").lower()
+    if "tpu" not in kind and not kind.startswith("v"):
+        return None
+    for needle, spec in _CHIP_SPECS:
+        if needle in kind:
+            return spec
+    return None
+
+
+def mfu(achieved_tflops: float, device_kind: str) -> Optional[float]:
+    """Model FLOPs utilisation in [0, 1], or None off-spec hardware."""
+    spec = chip_spec(device_kind)
+    if spec is None or spec.bf16_tflops <= 0:
+        return None
+    return achieved_tflops / spec.bf16_tflops
+
+
+def default_hbm_floor_gbps(
+    device_kind: str, fraction: float = 0.5
+) -> float:
+    """A defensible min-HBM-bandwidth floor: ``fraction`` of chip spec
+    (0.0 when the chip is unknown — floor disabled)."""
+    spec = chip_spec(device_kind)
+    if spec is None:
+        return 0.0
+    return fraction * spec.hbm_gbps

@@ -670,6 +670,13 @@ class ClusterUpgradeStateManager:
         """Skip uncordon for groups whose every host started cordoned
         (upgrade_state.go:1000-1028); mixed groups go through uncordon,
         where per-host skip applies."""
+        # The group is past every gate: clear stored progress-blocker
+        # reasons so a stall in a FUTURE upgrade cycle is not attributed
+        # to this one's (resolved) drain/validation failures.
+        getattr(self.drain_manager, "last_error", {}).pop(group.id, None)
+        getattr(self.validation_manager, "last_rejection", {}).pop(
+            group.id, None
+        )
         key = self.keys.initial_state_annotation
         if all(key in m.node.annotations for m in group.members):
             self.provider.change_nodes_upgrade_state(

@@ -323,3 +323,54 @@ class CanaryRunner:
             return 0.0
         diffs = np.diff(np.asarray(self.step_times))
         return float(diffs.max())
+
+    # -- throughput / MFU ---------------------------------------------------
+
+    def param_count(self) -> int:
+        return int(
+            sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+        )
+
+    def flops_per_step(self) -> float:
+        """Training FLOPs per step: the standard 6·N·tokens matmul term
+        plus the 12·L·B·S²·D attention term (fwd+bwd, PaLM-appendix
+        convention — the MFU denominator every report uses)."""
+        cfg = self.cfg
+        tokens = cfg.batch * cfg.seq_len
+        matmul = 6.0 * self.param_count() * tokens
+        attention = 12.0 * cfg.n_layers * cfg.batch * cfg.seq_len**2 * cfg.d_model
+        return matmul + attention
+
+    def perf_summary(self) -> dict:
+        """tokens/s, achieved TFLOPS and MFU from the recorded steps.
+
+        Uses the *median* inter-step time so upgrade pauses (the gaps the
+        downtime metric measures) don't depress the throughput figure."""
+        from k8s_operator_libs_tpu.hw import mfu as _mfu
+
+        if len(self.step_times) < 2:
+            return {"steps": len(self.step_times)}
+        dt = float(np.median(np.diff(np.asarray(self.step_times))))
+        if dt <= 0:
+            return {"steps": len(self.step_times)}
+        cfg = self.cfg
+        tokens_per_s = cfg.batch * cfg.seq_len / dt
+        achieved_tflops = self.flops_per_step() / dt / 1e12
+        if self.mesh is not None:
+            devices = list(self.mesh.devices.flat)
+        else:
+            devices = [jax.devices()[0]]
+        # Per-device utilisation: the step's FLOPs are spread over the mesh.
+        per_device_tflops = achieved_tflops / max(1, len(devices))
+        out = {
+            "steps": len(self.step_times),
+            "median_step_s": dt,
+            "tokens_per_s": tokens_per_s,
+            "achieved_tflops": achieved_tflops,
+            "params": self.param_count(),
+            "device": devices[0].device_kind,
+        }
+        mfu_frac = _mfu(per_device_tflops, devices[0].device_kind)
+        if mfu_frac is not None:
+            out["mfu"] = mfu_frac
+        return out

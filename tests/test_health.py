@@ -54,12 +54,64 @@ def test_matmul_probe_exact(cpu_devices):
     res = matmul_probe(cpu_devices[0], n=128)
     assert res.ok, res.detail
     assert res.metrics["tflops"] > 0
+    # Sustained measurement: the fast tiny matmul must have been looped.
+    assert res.metrics["iters"] > 1
+
+
+def test_matmul_probe_rejects_non_pow2():
+    # Misconfiguration yields a failing, attributable check — never an
+    # exception that would abort the whole battery.
+    res = matmul_probe(None, n=100)
+    assert not res.ok
+    assert "power-of-two" in res.detail
 
 
 def test_hbm_bandwidth_probe(cpu_devices):
     res = hbm_bandwidth_probe(cpu_devices[0], mib=1)
     assert res.ok, res.detail
     assert res.metrics["gbps"] > 0
+    assert res.metrics["iters"] > 1
+
+
+def test_chip_spec_table():
+    from k8s_operator_libs_tpu.hw import (
+        chip_spec,
+        default_hbm_floor_gbps,
+        mfu,
+    )
+
+    v5e = chip_spec("TPU v5 lite")
+    assert v5e is not None and v5e.name == "v5e"
+    assert v5e.bf16_tflops == 197.0 and v5e.hbm_gbps == 819.0
+    assert chip_spec("TPU v5p") is not None
+    assert chip_spec("cpu") is None  # unknown -> spec checks disabled
+    assert chip_spec("") is None
+    assert mfu(98.5, "TPU v5 lite") == 0.5
+    assert mfu(10.0, "cpu") is None
+    assert default_hbm_floor_gbps("TPU v5 lite") == 819.0 / 2
+    assert default_hbm_floor_gbps("cpu") == 0.0
+
+
+def test_canary_perf_summary(cpu_devices):
+    from k8s_operator_libs_tpu.workloads import CanaryConfig, CanaryRunner
+
+    cfg = CanaryConfig(
+        vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, seq_len=16,
+        batch=2,
+    )
+    runner = CanaryRunner(cfg)
+    for _ in range(4):
+        runner.run_step()
+    summary = runner.perf_summary()
+    assert summary["steps"] == 4
+    assert summary["tokens_per_s"] > 0
+    assert summary["achieved_tflops"] > 0
+    assert summary["params"] == runner.param_count() > 0
+    # MFU is claimed exactly when the device has a known chip spec (the
+    # default backend may be a real TPU even under JAX_PLATFORMS=cpu).
+    from k8s_operator_libs_tpu.hw import chip_spec
+
+    assert ("mfu" in summary) == (chip_spec(summary["device"]) is not None)
 
 
 def test_ici_allreduce_probe_exact(cpu_devices):
