@@ -302,6 +302,17 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # reason (0 disables).  Distinct from the validation timeout: this is
     # telemetry, not a transition.
     stuck_threshold_second: int = 300
+    # Pipelined validation ("optimistic uncordon"): as soon as a slice's
+    # driver pods are back in sync, its hosts are uncordoned and the
+    # workload readmitted WHILE the health gate still runs; a slice in
+    # that phase is schedulable, so it stops consuming parallel slots and
+    # unavailability budget and the next slice's drain overlaps its
+    # validation.  A failed/timed-out gate re-cordons the slice and marks
+    # it upgrade-failed.  Tradeoff (opt-in): the workload may run briefly
+    # on a slice the gate later rejects — acceptable when the continuous
+    # per-host probe agents already vouch for basic chip health, and
+    # required to meet a <2 min budget on multi-slice pools.
+    pipeline_validation: bool = False
 
     def validate(self) -> None:
         super().validate()

@@ -170,7 +170,19 @@ def _timed_sustained(
     k2 = 4 * k1
     t1 = run(k1, start_args())
     t2 = run(k2, start_args())
-    per_s = max((t2 - t1) / (k2 - k1), 1e-9)
+    if t2 <= t1:
+        # Timing anomaly (host stall during the short run).  Retry the
+        # pair once; a still-invalid slope must FAIL the measurement —
+        # clamping would report absurd throughput as a passing figure,
+        # letting a degraded chip sail over its health floor.
+        t1 = run(k1, start_args())
+        t2 = run(k2, start_args())
+        if t2 <= t1:
+            raise RuntimeError(
+                f"unstable timing: {k1} iters took {t1:.4f}s but {k2} "
+                f"iters took {t2:.4f}s; cannot measure sustained rate"
+            )
+    per_s = (t2 - t1) / (k2 - k1)
     return per_s * 1e3, state["out"], state["applied"]
 
 

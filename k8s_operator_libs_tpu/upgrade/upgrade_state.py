@@ -124,6 +124,9 @@ class ClusterUpgradeStateManager:
         self.validation_manager = validation_manager or ValidationManager(
             client, self.provider, self.keys, None, event_recorder
         )
+        if getattr(self.validation_manager, "cordon_manager", None) is None:
+            # For pipelined validation's re-cordon-on-timeout rollback.
+            self.validation_manager.cordon_manager = self.cordon_manager
         self.safe_driver_load_manager = (
             safe_driver_load_manager
             or SafeDriverLoadManager(self.provider, self.keys)
@@ -306,6 +309,13 @@ class ClusterUpgradeStateManager:
             if not policy.health_gate.enable:
                 validation_active = False
 
+        pipeline = (
+            isinstance(policy, TPUUpgradePolicySpec)
+            and policy.pipeline_validation
+        )
+        # Pipelined validation re-cordons a slice whose gate fails.
+        self.validation_manager.recordon_on_timeout = pipeline
+
         unit = self._unavailability_unit(policy)
         total_units = self._total_units(current_state, unit)
         max_unavailable = total_units
@@ -314,7 +324,8 @@ class ClusterUpgradeStateManager:
                 total_units, round_up=True
             )
         upgrades_available = self.get_upgrades_available_units(
-            current_state, policy.max_parallel_upgrades, max_unavailable, unit
+            current_state, policy.max_parallel_upgrades, max_unavailable,
+            unit, pipeline=pipeline,
         )
         logger.info(
             "upgrades in progress: %d, available slots: %d (unit=%s, "
@@ -340,8 +351,10 @@ class ClusterUpgradeStateManager:
             current_state, policy.pod_deletion, drain_enabled
         )
         self.process_drain_groups(current_state, policy.drain_spec)
-        self.process_pod_restart_groups(current_state, validation_active)
-        self.process_upgrade_failed_groups(current_state)
+        self.process_pod_restart_groups(
+            current_state, validation_active, pipeline=pipeline
+        )
+        self.process_upgrade_failed_groups(current_state, validation_active)
         self.process_validation_required_groups(current_state, validation_active)
         self.process_uncordon_required_groups(current_state)
         if isinstance(policy, TPUUpgradePolicySpec):
@@ -561,10 +574,19 @@ class ClusterUpgradeStateManager:
         )
 
     def process_pod_restart_groups(
-        self, state: ClusterUpgradeState, validation_active: Optional[bool] = None
+        self,
+        state: ClusterUpgradeState,
+        validation_active: Optional[bool] = None,
+        pipeline: bool = False,
     ) -> None:
         """Restart outdated driver pods; advance fully-recovered groups
-        (upgrade_state.go:764-831)."""
+        (upgrade_state.go:764-831).
+
+        With ``pipeline`` (TPUUpgradePolicySpec.pipeline_validation) a
+        fully-synced group is uncordoned ON ENTRY to validation: the
+        workload is readmitted while the health gate runs, so the slice
+        stops counting against parallel/unavailability budgets and the
+        next slice's drain overlaps this one's validation."""
         if validation_active is None:
             validation_active = self.is_validation_enabled()
         for group in state.groups_in(UpgradeState.POD_RESTART_REQUIRED):
@@ -609,18 +631,53 @@ class ClusterUpgradeStateManager:
             self.safe_driver_load_manager.unblock_group_loading(group)
             if all(self._is_driver_pod_in_sync(m) for m in group.members):
                 if validation_active:
+                    if pipeline:
+                        # Optimistic uncordon: readmit the workload now;
+                        # hosts that started cordoned stay cordoned.
+                        key = self.keys.initial_state_annotation
+                        self.cordon_manager.uncordon_nodes(
+                            [
+                                m.node
+                                for m in group.members
+                                if key not in m.node.annotations
+                            ]
+                        )
                     self.provider.change_nodes_upgrade_state(
                         group.nodes, UpgradeState.VALIDATION_REQUIRED
                     )
                 else:
                     self._update_group_to_uncordon_or_done(group)
 
-    def process_upgrade_failed_groups(self, state: ClusterUpgradeState) -> None:
+    def process_upgrade_failed_groups(
+        self,
+        state: ClusterUpgradeState,
+        validation_active: Optional[bool] = None,
+    ) -> None:
         """Auto-recover failed groups whose driver pods are all back in sync
-        (upgrade_state.go:835-877)."""
+        (upgrade_state.go:835-877) — AND whose health gate passes.
+
+        The reference's recovery predicate is pod-sync alone because its
+        validation IS a pod-Ready check; here the gate is stronger (slice
+        re-formation, ICI collectives), so recovering on pod sync alone
+        would silently bless a slice the gate explicitly rejected (e.g.
+        after a validation timeout — with pipelined validation that would
+        re-admit the workload onto unvalidated hardware)."""
+        if validation_active is None:
+            validation_active = self.is_validation_enabled()
         for group in state.groups_in(UpgradeState.FAILED):
-            if all(self._is_driver_pod_in_sync(m) for m in group.members):
-                self._update_group_to_uncordon_or_done(group)
+            if not all(self._is_driver_pod_in_sync(m) for m in group.members):
+                continue
+            if validation_active and self.validation_manager.prober is not None:
+                result = self.validation_manager.prober.probe(group)
+                if not result.healthy:
+                    logger.info(
+                        "failed group %s stays failed: health gate "
+                        "rejects recovery: %s",
+                        group.id,
+                        result.detail,
+                    )
+                    continue
+            self._update_group_to_uncordon_or_done(group)
 
     def process_validation_required_groups(
         self, state: ClusterUpgradeState, validation_active: Optional[bool] = None
@@ -782,11 +839,50 @@ class ClusterUpgradeStateManager:
             return self.get_total_managed_groups(state)
         return self.get_total_managed_nodes(state)
 
-    def _in_progress_units(self, state: ClusterUpgradeState, unit: str) -> int:
+    def _group_validating_schedulable(self, group: UpgradeGroup) -> bool:
+        """True when the group is in validation with every host back in
+        service — the pipelined-validation phase that releases its
+        parallel slot (its workload is already readmitted).  Hosts that
+        started cordoned (initial_state_annotation) stay cordoned by
+        design and must not pin the group 'unavailable'."""
+        key = self.keys.initial_state_annotation
+        return not any(
+            (m.node.spec.unschedulable and key not in m.node.annotations)
+            or not m.node.is_ready()
+            for m in group.members
+        )
+
+    def _in_progress_units(
+        self, state: ClusterUpgradeState, unit: str, pipeline: bool = False
+    ) -> int:
         if unit == "slice":
-            return sum(
-                len(state.groups_in(s)) for s in IN_PROGRESS_STATES
-            )
+            count = 0
+            for s in IN_PROGRESS_STATES:
+                for group in state.groups_in(s):
+                    if (
+                        pipeline
+                        and s == UpgradeState.VALIDATION_REQUIRED
+                        and self._group_validating_schedulable(group)
+                    ):
+                        continue
+                    count += 1
+            return count
+        if pipeline:
+            key = self.keys.initial_state_annotation
+            count = 0
+            for s in IN_PROGRESS_STATES:
+                for nus in state.nodes_in(s):
+                    if (
+                        s == UpgradeState.VALIDATION_REQUIRED
+                        and (
+                            not nus.node.spec.unschedulable
+                            or key in nus.node.annotations
+                        )
+                        and nus.node.is_ready()
+                    ):
+                        continue
+                    count += 1
+            return count
         return self.get_upgrades_in_progress(state)
 
     def _unavailable_units(self, state: ClusterUpgradeState, unit: str) -> int:
@@ -802,10 +898,12 @@ class ClusterUpgradeStateManager:
         max_parallel_upgrades: int,
         max_unavailable: int,
         unit: str = "node",
+        pipeline: bool = False,
     ) -> int:
         """Slot math (upgrade_state.go:1074-1102), at node or slice
-        granularity."""
-        in_progress = self._in_progress_units(state, unit)
+        granularity.  ``pipeline`` releases the slots of validating units
+        whose hosts are already back in service (pipelined validation)."""
+        in_progress = self._in_progress_units(state, unit, pipeline)
         total = self._total_units(state, unit)
 
         if max_parallel_upgrades == 0:

@@ -109,6 +109,12 @@ class ValidationManager:
         # Last rejection reason per group id, consumed by the stuck-state
         # detector so a long validation wait is attributable in events.
         self.last_rejection: dict[str, str] = {}
+        # Pipelined validation (optimistic uncordon): the group's hosts
+        # were readmitted before the gate passed, so a timed-out gate
+        # must take them back out of service.  Wired by the state
+        # manager; set per apply_state from the policy.
+        self.cordon_manager = None
+        self.recordon_on_timeout = False
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -150,6 +156,11 @@ class ValidationManager:
             # The group leaves validation: a stale rejection must not be
             # attributed to a future stall in a different phase.
             self.last_rejection.pop(group.id, None)
+            if self.recordon_on_timeout and self.cordon_manager is not None:
+                # Optimistic-uncordon rollback: the workload was
+                # readmitted before the gate; an unvalidated slice must
+                # not keep serving it.
+                self.cordon_manager.cordon_nodes(group.nodes)
             for node in group.nodes:
                 log_event(
                     self.event_recorder,

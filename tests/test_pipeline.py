@@ -1,0 +1,194 @@
+"""Pipelined validation ("optimistic uncordon", SURVEY.md §7 hard part
+'Downtime budget'): overlapping slice N+1's drain with slice N's health
+gate while never having two slices simultaneously out of service.
+
+The serialized engine holds a slice cordoned for its whole validation
+(reference semantics); with a multi-tick health gate that serializes the
+entire roll end-to-end.  pipeline_validation readmits the workload the
+moment the driver pods are back in sync, so a validating slice is
+schedulable — it stops consuming parallel slots and unavailability
+budget, and the next slice proceeds.
+"""
+
+from __future__ import annotations
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    ProbeResult,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+
+KEYS = UpgradeKeys()
+N_SLICES = 3
+HOSTS = 2
+# Ticks of validation latency per slice (fresh reports under the new
+# driver take a probe-agent cycle or two to appear).
+VALIDATION_TICKS = 5
+
+
+class SlowProber:
+    """Rejects each group's first VALIDATION_TICKS probes (a stand-in for
+    waiting on fresh per-host reports), then passes."""
+
+    def __init__(self, ticks: int = VALIDATION_TICKS) -> None:
+        self.ticks = ticks
+        self.calls: dict[str, int] = {}
+
+    def probe(self, group) -> ProbeResult:
+        seen = self.calls.get(group.id, 0) + 1
+        self.calls[group.id] = seen
+        if seen <= self.ticks:
+            return ProbeResult(False, f"reports pending ({seen}/{self.ticks})")
+        return ProbeResult(True, "all reports healthy")
+
+
+def _build(pipeline: bool):
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    slices = [
+        fx.tpu_slice(f"pool-{i}", hosts=HOSTS) for i in range(N_SLICES)
+    ]
+    for nodes in slices:
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(SlowProber())
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        pipeline_validation=pipeline,
+        health_gate=SliceHealthGateSpec(timeout_second=600),
+    )
+    return c, mgr, policy, slices
+
+
+def _run(pipeline: bool, max_ticks: int = 120):
+    c, mgr, policy, slices = _build(pipeline)
+    names = [[n.name for n in nodes] for nodes in slices]
+    max_simultaneous_unavailable = 0
+    for tick in range(1, max_ticks + 1):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(30.0)
+        unavailable = sum(
+            1
+            for slice_names in names
+            for _ in [0]
+            if any(
+                c.get_node(n, cached=False).spec.unschedulable
+                for n in slice_names
+            )
+        )
+        max_simultaneous_unavailable = max(
+            max_simultaneous_unavailable, unavailable
+        )
+        states = {
+            c.get_node(n, cached=False).labels.get(KEYS.state_label, "")
+            for slice_names in names
+            for n in slice_names
+        }
+        if states == {UpgradeState.DONE.value}:
+            return tick, max_simultaneous_unavailable, c
+    raise AssertionError(f"did not converge in {max_ticks} ticks")
+
+
+def test_pipeline_overlaps_validation_and_respects_unavailability():
+    serial_ticks, serial_unavail, _ = _run(pipeline=False)
+    pipe_ticks, pipe_unavail, _ = _run(pipeline=True)
+    # Never two slices simultaneously out of service, in either mode.
+    assert serial_unavail == 1
+    assert pipe_unavail == 1
+    # Wall-clock (ticks) drops: validation overlaps the next slice's
+    # cordon/drain instead of serializing after it.  With 3 slices and a
+    # 5-tick gate, the pipeline hides ~2 gates' worth of ticks.
+    assert pipe_ticks < serial_ticks - VALIDATION_TICKS, (
+        f"pipelined {pipe_ticks} vs serial {serial_ticks}"
+    )
+
+
+def test_pipeline_uncordons_on_validation_entry():
+    c, mgr, policy, slices = _build(pipeline=True)
+    names0 = [n.name for n in slices[0]]
+    for _ in range(60):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(30.0)
+        labels = {
+            c.get_node(n, cached=False).labels.get(KEYS.state_label, "")
+            for n in names0
+        }
+        if labels == {UpgradeState.VALIDATION_REQUIRED.value}:
+            # In validation AND already schedulable: the workload is back.
+            assert not any(
+                c.get_node(n, cached=False).spec.unschedulable
+                for n in names0
+            )
+            return
+    raise AssertionError("slice 0 never reached validation")
+
+
+def test_pipeline_validation_timeout_recordons():
+    """The rollback path: a gate that times out must take the
+    optimistically-readmitted slice back out of service."""
+    import time
+
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="v2", revision=2)
+    old = str(int(time.time()) - 100)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v2")
+        c.patch_node_labels(
+            n.name,
+            {KEYS.state_label: UpgradeState.VALIDATION_REQUIRED.value},
+        )
+        c.patch_node_annotations(
+            n.name, {KEYS.validation_start_time_annotation: old}
+        )
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(SlowProber(ticks=10**6))
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        pipeline_validation=True,
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        health_gate=SliceHealthGateSpec(timeout_second=30),
+    )
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    for n in nodes:
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+        # Re-cordoned: an unvalidated slice must not serve the workload.
+        assert c.get_node(n.name, cached=False).spec.unschedulable
+    # The rollback must HOLD across subsequent reconciles: driver pods
+    # are in sync (that's how the slice reached validation), but the
+    # gate still rejects — auto-recovery on pod sync alone would bless
+    # the slice the gate explicitly failed.
+    for _ in range(3):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+        for n in nodes:
+            assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+            assert c.get_node(n.name, cached=False).spec.unschedulable
+    # Once the gate passes (slice genuinely healed), recovery proceeds.
+    mgr.validation_manager.prober = SlowProber(ticks=0)
+    for _ in range(3):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    for n in nodes:
+        assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
+        assert not c.get_node(n.name, cached=False).spec.unschedulable
