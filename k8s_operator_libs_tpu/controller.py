@@ -80,7 +80,10 @@ class UpgradeController:
             client, keys=self.keys, event_recorder=self.events
         )
         # TPU health gate: per-host probe-agent reports aggregated per
-        # slice, pinned to the current driver revision.
+        # slice, pinned to the current driver revision.  The HBM floor is
+        # derived per slice from the accelerator's published spec
+        # (hw.chip_spec), so the silent-degradation mode the bandwidth
+        # probe measures actually gates in the default wiring.
         self.manager.with_validation_enabled(
             NodeReportProber(
                 self.keys,
@@ -88,6 +91,7 @@ class UpgradeController:
                     self.manager.pod_manager
                     .get_daemonset_controller_revision_hash
                 ),
+                hbm_floor_fraction=0.5,
             )
         )
         self.ds_reconciler = (

@@ -168,22 +168,36 @@ def _timed_sustained(
     per_est = max(pilot_s / 2, 1e-7)
     k1 = max(16, min(max_iters // 4, int(min_time_s / per_est) + 1))
     k2 = 4 * k1
-    t1 = run(k1, start_args())
-    t2 = run(k2, start_args())
-    if t2 <= t1:
-        # Timing anomaly (host stall during the short run).  Retry the
-        # pair once; a still-invalid slope must FAIL the measurement —
-        # clamping would report absurd throughput as a passing figure,
-        # letting a degraded chip sail over its health floor.
+    # Measure up to three slope pairs and keep the BEST (minimum per-op
+    # time) valid one: a host stall inflates a run, so the minimum over
+    # pairs is the estimator least contaminated by host noise — one noisy
+    # measurement must not flip a health verdict (a transient stall
+    # marking a healthy chip unhealthy feeds false negatives into the
+    # validation gate and failed-group recovery).  Only when every pair
+    # is non-monotonic do we fail: clamping a still-invalid slope would
+    # report absurd throughput as a passing figure, letting a degraded
+    # chip sail over its health floor.
+    best_per_s: Optional[float] = None
+    valid = 0
+    pairs: list[tuple[float, float]] = []
+    for _ in range(3):
         t1 = run(k1, start_args())
         t2 = run(k2, start_args())
-        if t2 <= t1:
-            raise RuntimeError(
-                f"unstable timing: {k1} iters took {t1:.4f}s but {k2} "
-                f"iters took {t2:.4f}s; cannot measure sustained rate"
-            )
-    per_s = (t2 - t1) / (k2 - k1)
-    return per_s * 1e3, state["out"], state["applied"]
+        pairs.append((t1, t2))
+        if t2 > t1:
+            valid += 1
+            per_s = (t2 - t1) / (k2 - k1)
+            if best_per_s is None or per_s < best_per_s:
+                best_per_s = per_s
+            if valid >= 2:
+                break
+    if best_per_s is None:
+        raise RuntimeError(
+            f"unstable timing: {k1}- vs {k2}-iteration runs were "
+            f"non-monotonic in all {len(pairs)} attempts ({pairs}); "
+            "cannot measure sustained rate"
+        )
+    return best_per_s * 1e3, state["out"], state["applied"]
 
 
 def device_inventory(

@@ -29,7 +29,6 @@ import jax
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.health.probes import run_host_probe
 from k8s_operator_libs_tpu.health.report import HealthReport
-from k8s_operator_libs_tpu.topology.slices import ACCELERATOR_CHIPS_PER_HOST
 from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
 from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
 from k8s_operator_libs_tpu.upgrade.validation_manager import ProbeResult
@@ -77,11 +76,12 @@ class LocalDeviceProber:
 
 
 def expected_chips_per_host(group: UpgradeGroup) -> int:
-    """Chips each host of this group should enumerate, from its slice
-    accelerator type (0 = unknown, don't enforce)."""
+    """Chips each host of this group should enumerate (0 = unknown, don't
+    enforce): the explicit chips-per-host label override first, then the
+    accelerator table, then the topology's chips over expected hosts."""
     if group.slice_info is None:
         return 0
-    return ACCELERATOR_CHIPS_PER_HOST.get(group.slice_info.accelerator, 0)
+    return group.slice_info.host_chips()
 
 
 class NodeReportProber:
@@ -98,12 +98,19 @@ class NodeReportProber:
         # 0 disables (enumeration+correctness checks still apply).
         min_hbm_gbps: float = 0.0,
         min_ici_busbw_gbps: float = 0.0,
+        # When > 0 and no explicit min_hbm_gbps is given, derive the HBM
+        # floor per group as this fraction of the slice accelerator's
+        # published spec (hw.chip_spec) — the default production wiring,
+        # so the silent-HBM-degradation mode the probe exists to catch
+        # actually gates.  Unknown accelerators leave the floor off.
+        hbm_floor_fraction: float = 0.0,
     ) -> None:
         self.keys = keys
         self.max_report_age_s = max_report_age_s
         self.revision_resolver = revision_resolver
         self.min_hbm_gbps = min_hbm_gbps
         self.min_ici_busbw_gbps = min_ici_busbw_gbps
+        self.hbm_floor_fraction = hbm_floor_fraction
 
     def _required_revision(self, group: UpgradeGroup) -> str:
         if self.revision_resolver is None:
@@ -113,11 +120,33 @@ class NodeReportProber:
                 return self.revision_resolver(member.driver_daemon_set) or ""
         return ""
 
+    def _hbm_floor(self, group: UpgradeGroup) -> float:
+        """Effective HBM floor for this group: explicit wins; else derive
+        from the slice accelerator's published spec."""
+        if self.min_hbm_gbps or not self.hbm_floor_fraction:
+            return self.min_hbm_gbps
+        if group.slice_info is None:
+            return 0.0
+        from k8s_operator_libs_tpu.hw import chip_spec
+
+        spec = chip_spec(group.slice_info.accelerator)
+        if spec is None:
+            return 0.0
+        return self.hbm_floor_fraction * spec.hbm_gbps
+
     def _check_report(
         self, report: HealthReport, group: UpgradeGroup, required_rev: str,
-        now: float,
+        now: float, hbm_floor: float = 0.0,
     ) -> Optional[str]:
-        """Return a rejection reason, or None if the report is acceptable."""
+        """Return a rejection reason, or None if the report is acceptable.
+
+        ``now`` is the staleness reference point.  Callers clamp it to the
+        gate's start time when one is recorded: a report must have been
+        fresh when the gate OPENED, not stay fresh while it runs — once
+        the workload is readmitted (pipelined validation) libtpu's
+        exclusive device lock stops the agent from probing, so demanding
+        continued freshness would time out every pipelined gate on real
+        multi-host slices (the device-contention constraint)."""
         if required_rev and report.driver_revision != required_rev:
             return (
                 f"report is for driver revision "
@@ -150,13 +179,13 @@ class NodeReportProber:
             )
         for check in report.checks:
             if (
-                self.min_hbm_gbps
+                hbm_floor
                 and check.name == "hbm_bandwidth"
-                and check.metrics.get("gbps", 0.0) < self.min_hbm_gbps
+                and check.metrics.get("gbps", 0.0) < hbm_floor
             ):
                 return (
                     f"HBM bandwidth {check.metrics.get('gbps', 0.0):.1f} "
-                    f"GB/s below floor {self.min_hbm_gbps:.1f}"
+                    f"GB/s below floor {hbm_floor:.1f}"
                 )
             if (
                 self.min_ici_busbw_gbps
@@ -173,8 +202,10 @@ class NodeReportProber:
 
     def probe(self, group: UpgradeGroup) -> ProbeResult:
         key = self.keys.health_report_annotation
+        start_key = self.keys.validation_start_time_annotation
         required_rev = self._required_revision(group)
         now = time.time()
+        hbm_floor = self._hbm_floor(group)
         for node in group.nodes:
             raw = node.annotations.get(key)
             if not raw:
@@ -185,7 +216,14 @@ class NodeReportProber:
                 report = HealthReport.from_json(raw)
             except ValueError as e:
                 return ProbeResult(False, f"node {node.name}: {e}")
-            reason = self._check_report(report, group, required_rev, now)
+            # Staleness reference: the gate's start time when stamped (the
+            # workload may have re-locked the devices since — see
+            # _check_report), else now.
+            raw_start = node.annotations.get(start_key, "")
+            ref = min(now, float(raw_start)) if raw_start.isdigit() else now
+            reason = self._check_report(
+                report, group, required_rev, ref, hbm_floor
+            )
             if reason is not None:
                 return ProbeResult(False, f"node {node.name}: {reason}")
         return ProbeResult(

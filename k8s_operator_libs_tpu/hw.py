@@ -32,21 +32,29 @@ class ChipSpec:
 
 
 # Substring (lowercased) -> spec.  Order matters: more specific first.
+# All values are PER-CHIP (v2/v3 HBM capacities are the chip totals, 16/32
+# GiB — not the per-core 8/16 some tables quote).  The bare "v5" needle
+# last is a fallback: some libtpu versions report v5p as plain "TPU v5",
+# which must not silently disable MFU math and HBM floors.
 _CHIP_SPECS: list[tuple[str, ChipSpec]] = [
     ("v5 lite", ChipSpec("v5e", 197.0, 819.0, 16.0)),
     ("v5litepod", ChipSpec("v5e", 197.0, 819.0, 16.0)),
+    ("v5-lite", ChipSpec("v5e", 197.0, 819.0, 16.0)),
     ("v5e", ChipSpec("v5e", 197.0, 819.0, 16.0)),
     ("v5p", ChipSpec("v5p", 459.0, 2765.0, 95.0)),
     ("v6 lite", ChipSpec("v6e", 918.0, 1640.0, 32.0)),
     ("v6e", ChipSpec("v6e", 918.0, 1640.0, 32.0)),
     ("v4", ChipSpec("v4", 275.0, 1228.0, 32.0)),
-    ("v3", ChipSpec("v3", 123.0, 900.0, 16.0)),
-    ("v2", ChipSpec("v2", 45.0, 700.0, 8.0)),
+    ("v3", ChipSpec("v3", 123.0, 900.0, 32.0)),
+    ("v2", ChipSpec("v2", 45.0, 700.0, 16.0)),
+    ("v5", ChipSpec("v5p", 459.0, 2765.0, 95.0)),
 ]
 
 
 def chip_spec(device_kind: str) -> Optional[ChipSpec]:
-    """Spec for a ``jax.Device.device_kind`` string, or None if unknown."""
+    """Spec for a ``jax.Device.device_kind`` string (e.g. ``"TPU v5 lite"``)
+    or a GKE accelerator label (e.g. ``"tpu-v5-lite-podslice"``), or None
+    if unknown."""
     kind = (device_kind or "").lower()
     if "tpu" not in kind and not kind.startswith("v"):
         return None

@@ -78,12 +78,20 @@ def chips_for_topology(topology: str) -> int:
     return math.prod(dims) if dims else 0
 
 
-def hosts_for_topology(topology: str, accelerator: str = "") -> int:
-    """Expected host (node) count for a slice topology."""
+def hosts_for_topology(
+    topology: str, accelerator: str = "", chips_per_host: int = 0
+) -> int:
+    """Expected host (node) count for a slice topology.
+
+    ``chips_per_host`` > 0 overrides the accelerator table (explicit
+    ``UpgradeKeys.chips_per_host_label`` on the nodes — sub-host v5e
+    topologies and shapes the table doesn't know)."""
     chips = chips_for_topology(topology)
     if chips == 0:
         return 1
-    per_host = ACCELERATOR_CHIPS_PER_HOST.get(accelerator, DEFAULT_CHIPS_PER_HOST)
+    per_host = chips_per_host or ACCELERATOR_CHIPS_PER_HOST.get(
+        accelerator, DEFAULT_CHIPS_PER_HOST
+    )
     return max(1, chips // per_host)
 
 
@@ -99,10 +107,30 @@ class SliceInfo:
     # same group back one data-parallel JobSet and must not be down
     # simultaneously (BASELINE config 5).
     dcn_group: Optional[str] = None
+    # Explicit per-host chip count (chips_per_host_label); 0 = derive from
+    # the accelerator table / topology.
+    chips_per_host: int = 0
 
     @property
     def chips(self) -> int:
-        return chips_for_topology(self.topology) or self.expected_hosts * 4
+        return chips_for_topology(self.topology) or (
+            self.expected_hosts * (self.chips_per_host or 4)
+        )
+
+    def host_chips(self) -> int:
+        """Chips each host of this slice should enumerate (0 = unknown).
+
+        Explicit override first; else the accelerator table; else derived
+        from the topology's total chip count over the expected hosts."""
+        if self.chips_per_host:
+            return self.chips_per_host
+        per_host = ACCELERATOR_CHIPS_PER_HOST.get(self.accelerator, 0)
+        if per_host:
+            return per_host
+        total = chips_for_topology(self.topology)
+        if total and self.expected_hosts:
+            return max(1, total // self.expected_hosts)
+        return 0
 
     def is_multi_host(self) -> bool:
         return self.expected_hosts > 1
@@ -120,15 +148,18 @@ def slice_info_for_node(node: Node, keys: UpgradeKeys) -> Optional[SliceInfo]:
     slice_id = labels.get(keys.slice_id_label) or labels.get(GKE_NODEPOOL_LABEL)
     if not slice_id or not (accelerator or topology):
         return None
+    raw_cph = labels.get(keys.chips_per_host_label, "")
+    chips_per_host = int(raw_cph) if raw_cph.isdigit() else 0
     return SliceInfo(
         slice_id=slice_id,
         accelerator=accelerator,
         topology=topology,
-        expected_hosts=hosts_for_topology(topology, accelerator),
+        expected_hosts=hosts_for_topology(topology, accelerator, chips_per_host),
         dcn_group=(
             labels.get(keys.dcn_group_label)
             or _jobset_dcn_group(labels)
         ),
+        chips_per_host=chips_per_host,
     )
 
 

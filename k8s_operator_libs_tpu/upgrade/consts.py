@@ -131,6 +131,13 @@ HEALTH_REPORT_ANNOTATION_KEY_FMT = "{domain}/{driver}-health-report"
 # Multi-slice (DCN) group identity: slices in the same group serve one
 # data-parallel JobSet and must never be down simultaneously.
 DCN_GROUP_LABEL_KEY_FMT = "{domain}/{driver}-dcn-group"
+# Explicit chips-per-host override for slice-shape math.  GKE's accelerator
+# label only implies a per-host chip count for the standard machine shapes
+# (topology/slices.ACCELERATOR_CHIPS_PER_HOST); sub-host topologies (v5e
+# 1x1/2x2 single-chip or quad-chip hosts) and future shapes carry this
+# label so host-count math and the health gate's chip-count predicate match
+# the hardware actually attached, not the table's assumption.
+CHIPS_PER_HOST_LABEL_KEY_FMT = "{domain}/{driver}-chips-per-host"
 
 # GKE TPU node labels (canonical definitions live in topology.slices,
 # which must not depend on this package; re-exported here for convenience).

@@ -130,6 +130,10 @@ class UpgradeKeys:
         return self._fmt(C.DCN_GROUP_LABEL_KEY_FMT)
 
     @property
+    def chips_per_host_label(self) -> str:
+        return self._fmt(C.CHIPS_PER_HOST_LABEL_KEY_FMT)
+
+    @property
     def health_report_annotation(self) -> str:
         return self._fmt(C.HEALTH_REPORT_ANNOTATION_KEY_FMT)
 

@@ -36,6 +36,7 @@ from k8s_operator_libs_tpu.upgrade.util import (
     EVENT_TYPE_WARNING,
     EventRecorder,
     UpgradeKeys,
+    WorkerTracker,
     log_event,
 )
 
@@ -115,6 +116,14 @@ class ValidationManager:
         # manager; set per apply_state from the policy.
         self.cordon_manager = None
         self.recordon_on_timeout = False
+        # Rollback workers evicting the readmitted workload (joinable via
+        # wait_idle, test/bench convenience).
+        self._tracker = WorkerTracker()
+        # Drain settings for the rollback eviction; force=True because the
+        # gate rejected the hardware outright — even unmanaged pods must
+        # not keep running on it.
+        self.rollback_drain_timeout_s = 300.0
+        self.rollback_poll_interval_s = 1.0
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -159,8 +168,13 @@ class ValidationManager:
             if self.recordon_on_timeout and self.cordon_manager is not None:
                 # Optimistic-uncordon rollback: the workload was
                 # readmitted before the gate; an unvalidated slice must
-                # not keep serving it.
+                # not keep serving it.  Cordon alone only blocks NEW
+                # scheduling — the readmitted pods would keep running on
+                # hardware the gate rejected — so also evict them (async:
+                # eviction honors PDBs and can block; FAILED groups have
+                # no drain processor to pick this up later).
                 self.cordon_manager.cordon_nodes(group.nodes)
+                self._schedule_rollback_eviction(group)
             for node in group.nodes:
                 log_event(
                     self.event_recorder,
@@ -173,3 +187,37 @@ class ValidationManager:
                 group.nodes, UpgradeState.FAILED
             )
             self.provider.change_nodes_upgrade_annotation(group.nodes, key, "null")
+
+    def _schedule_rollback_eviction(self, group: UpgradeGroup) -> None:
+        """Evict the workload pods readmitted by the optimistic uncordon."""
+        from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+
+        helper = DrainHelper(
+            self.client,
+            force=True,
+            ignore_all_daemon_sets=True,
+            delete_empty_dir_data=True,
+            timeout_s=self.rollback_drain_timeout_s,
+            poll_interval_s=self.rollback_poll_interval_s,
+        )
+        node_names = [n.name for n in group.nodes]
+
+        def _rollback() -> None:
+            for name in node_names:
+                try:
+                    helper.run_node_drain(name)
+                except Exception as e:  # noqa: BLE001 — best effort
+                    logger.error(
+                        "rollback eviction of node %s (group %s) failed: "
+                        "%s — workload pods may still be running on "
+                        "unvalidated hardware",
+                        name,
+                        group.id,
+                        e,
+                    )
+
+        self._tracker.spawn(_rollback, name=f"validation-rollback-{group.id}")
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Join outstanding rollback-eviction workers."""
+        return self._tracker.wait_idle(timeout_s)

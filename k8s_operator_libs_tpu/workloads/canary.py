@@ -292,6 +292,7 @@ class CanaryRunner:
             self._shard_batch = lambda b: b
         self.step_times: list[float] = []
         self.losses: list[float] = []
+        self.window_start = time.monotonic()
         self._batch_rng = np.random.default_rng(seed)
 
     def _make_batch(self) -> jax.Array:
@@ -316,13 +317,25 @@ class CanaryRunner:
         compile time doesn't count as an interruption)."""
         self.step_times = []
         self.losses = []
+        self.window_start = time.monotonic()
 
-    def max_gap_seconds(self) -> float:
-        """Longest interruption between consecutive completed steps."""
-        if len(self.step_times) < 2:
-            return 0.0
-        diffs = np.diff(np.asarray(self.step_times))
-        return float(diffs.max())
+    def max_gap_seconds(self, until: Optional[float] = None) -> float:
+        """Longest interruption between consecutive completed steps.
+
+        ``until`` (a ``time.monotonic()`` timestamp) closes the window: if
+        the workload is still disrupted when measurement ends, the OPEN
+        interval since the last completed step counts as a gap — otherwise
+        a canary that stalled terminally would report near-zero downtime
+        (the round-1/2 fiction this parameter exists to kill).  With no
+        completed steps at all, the whole window is the gap."""
+        times = np.asarray(self.step_times)
+        if times.size == 0:
+            return float(max(0.0, until - self.window_start)) if until else 0.0
+        gaps = np.diff(times) if times.size > 1 else np.asarray([0.0])
+        closed = float(gaps.max()) if gaps.size else 0.0
+        if until is not None:
+            return max(closed, float(until - times[-1]))
+        return closed
 
     # -- throughput / MFU ---------------------------------------------------
 

@@ -108,6 +108,7 @@ class ClusterFixture:
         topology: str = "2x2x4",
         state: Optional[UpgradeState] = None,
         dcn_group: Optional[str] = None,
+        chips_per_host: int = 0,
         **kwargs,
     ) -> Node:
         """A node belonging to a (possibly multi-host) TPU slice, carrying
@@ -120,6 +121,8 @@ class ClusterFixture:
         }
         if dcn_group:
             labels[self.keys.dcn_group_label] = dcn_group
+        if chips_per_host:
+            labels[self.keys.chips_per_host_label] = str(chips_per_host)
         labels.update(kwargs.pop("labels", {}))
         return self.node(
             name=name or f"{slice_id}-w{worker_id}", state=state,
