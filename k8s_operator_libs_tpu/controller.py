@@ -66,6 +66,11 @@ class ControllerConfig:
     # When set, the controller also owns the driver DaemonSet.
     daemonset_spec: Optional[DriverDaemonSetSpec] = None
     metrics_port: Optional[int] = None
+    # Health-gate HBM floor as a fraction of the slice accelerator's
+    # published spec bandwidth (hw.chip_spec).  0 disables the floor —
+    # only for environments whose probe hosts are not the accelerator the
+    # slice labels claim (CPU test rigs).
+    hbm_floor_fraction: float = 0.5
 
 
 class UpgradeController:
@@ -91,7 +96,7 @@ class UpgradeController:
                     self.manager.pod_manager
                     .get_daemonset_controller_revision_hash
                 ),
-                hbm_floor_fraction=0.5,
+                hbm_floor_fraction=config.hbm_floor_fraction,
             )
         )
         self.ds_reconciler = (

@@ -30,7 +30,6 @@ from k8s_operator_libs_tpu.k8s import FakeCluster
 from k8s_operator_libs_tpu.metrics import (
     MetricsRegistry,
     MetricsServer,
-    UpgradeMetrics,
 )
 from k8s_operator_libs_tpu.upgrade import UpgradeKeys
 from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
@@ -175,6 +174,11 @@ def test_controller_rolls_cluster_end_to_end(cpu_devices):
             max_parallel_upgrades=1,
             drain_spec=DrainSpec(enable=True, timeout_second=5),
         ),
+        # The probe "hosts" here are CPU devices — they can't meet a real
+        # TPU spec's bandwidth floor (the default 0.5 fraction gates on
+        # hw.chip_spec numbers; covered by
+        # test_node_report_prober_default_floor_gates in test_health.py).
+        hbm_floor_fraction=0.0,
     )
     controller = UpgradeController(cluster, config)
     controller.manager.provider.poll_interval_s = 0.01

@@ -135,7 +135,7 @@ def test_ici_allreduce_single_device_vacuous(cpu_devices):
 def test_ici_ring_probe(cpu_devices):
     res = ici_ring_probe(cpu_devices)
     assert res.ok, res.detail
-    assert "8 ring links" in res.detail
+    assert "all 8 locally-received ring link(s) verified" in res.detail
 
 
 def test_run_host_probe_all_checks(cpu_devices):
@@ -372,6 +372,71 @@ def test_node_report_prober_bandwidth_floor():
     res = NodeReportProber(KEYS, min_ici_busbw_gbps=500.0).probe(group)
     assert not res.healthy
     assert "below floor" in res.detail
+
+
+def test_probe_inconclusive_timing_is_not_failure(monkeypatch, cpu_devices):
+    """Host-timer noise that defeats the sustained estimator must yield a
+    passing-but-unmeasured check (correctness still verified), never a
+    failed health check (ADVICE r2: one noisy measurement flipped
+    verdicts)."""
+    from k8s_operator_libs_tpu.health import probes
+
+    def fake(fn, args, **kw):
+        out = fn(*args)
+        raise probes.InconclusiveTiming("unstable timing (forced)", out, 1)
+
+    monkeypatch.setattr(probes, "_timed_sustained", fake)
+    res = probes.matmul_probe(cpu_devices[0], n=64)
+    assert res.ok
+    assert res.metrics.get("timing_inconclusive") == 1.0
+    assert "tflops" not in res.metrics
+    res = probes.hbm_bandwidth_probe(cpu_devices[0], mib=1)
+    assert res.ok
+    assert "gbps" not in res.metrics
+    res = probes.ici_allreduce_probe(cpu_devices[:4], per_device_elems=64)
+    assert res.ok
+    assert "busbw_gbps" not in res.metrics
+
+
+def test_inconclusive_report_does_not_trip_floor():
+    """A floor-configured prober must treat an unmeasured bandwidth as
+    'no data' (retry next sweep), not as 0 GB/s below the floor."""
+    reports = [_healthy_report(f"host-{i}") for i in range(4)]
+    for rep in reports:
+        rep.checks[2].metrics = {"timing_inconclusive": 1.0}
+        rep.checks[3].metrics = {"timing_inconclusive": 1.0}
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    res = NodeReportProber(
+        KEYS, min_hbm_gbps=500.0, min_ici_busbw_gbps=500.0
+    ).probe(group)
+    assert res.healthy
+
+
+def test_node_report_prober_default_floor_gates():
+    """The production wiring (hbm_floor_fraction, no explicit floor) must
+    reject a silently-degraded HBM report: 100 GB/s on a v5p (spec 2765,
+    floor 1382.5) fails; a report at 80 % of spec passes."""
+    reports = [_healthy_report(f"host-{i}") for i in range(4)]
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    prober = NodeReportProber(KEYS, hbm_floor_fraction=0.5)
+    res = prober.probe(group)
+    assert not res.healthy
+    assert "below floor 1382" in res.detail
+
+    for rep in reports:
+        rep.checks[2].metrics["gbps"] = 0.8 * 2765.0
+    group = _group(_slice_nodes_with_reports(reports), _v5p_slice_info())
+    assert prober.probe(group).healthy
+
+    # Unknown accelerator: the derived floor switches off, never blocks.
+    info = SliceInfo(
+        slice_id="pool-x", accelerator="tpu-vfuture-slice",
+        topology="2x2x4", expected_hosts=4,
+    )
+    degraded = [_healthy_report(f"host-{i}") for i in range(4)]
+    assert NodeReportProber(KEYS, hbm_floor_fraction=0.5).probe(
+        _group(_slice_nodes_with_reports(degraded), info)
+    ).healthy
 
 
 # --- agent end-to-end on the fake cluster ----------------------------------
