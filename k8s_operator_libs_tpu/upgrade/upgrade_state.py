@@ -22,6 +22,7 @@ down either way.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from k8s_operator_libs_tpu.api.v1alpha1 import (
@@ -144,6 +145,13 @@ class ClusterUpgradeStateManager:
                 self.stuck_detector.add_reason_source(reasons.get)
         self._pod_deletion_enabled = False
         self._validation_enabled = False
+        # Failed-group recovery probes are rate-limited: with a local
+        # prober the full sustained battery (≥50 ms device probes + ICI
+        # collectives) would otherwise run synchronously inside EVERY
+        # reconcile pass for EVERY pod-synced failed group.  A rejection
+        # is cached for this window before re-probing.
+        self.recovery_probe_backoff_s = 30.0
+        self._recovery_rejections: dict[str, float] = {}
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -664,20 +672,38 @@ class ClusterUpgradeStateManager:
         re-admit the workload onto unvalidated hardware)."""
         if validation_active is None:
             validation_active = self.is_validation_enabled()
+        failed_ids = set()
         for group in state.groups_in(UpgradeState.FAILED):
+            failed_ids.add(group.id)
             if not all(self._is_driver_pod_in_sync(m) for m in group.members):
                 continue
             if validation_active and self.validation_manager.prober is not None:
+                last = self._recovery_rejections.get(group.id)
+                now = time.monotonic()
+                if (
+                    last is not None
+                    and now - last < self.recovery_probe_backoff_s
+                ):
+                    # Recently rejected; don't re-run the battery yet.
+                    continue
                 result = self.validation_manager.prober.probe(group)
                 if not result.healthy:
+                    self._recovery_rejections[group.id] = now
                     logger.info(
                         "failed group %s stays failed: health gate "
-                        "rejects recovery: %s",
+                        "rejects recovery: %s (next probe in %.0fs)",
                         group.id,
                         result.detail,
+                        self.recovery_probe_backoff_s,
                     )
                     continue
+                self._recovery_rejections.pop(group.id, None)
             self._update_group_to_uncordon_or_done(group)
+        # Groups that left FAILED (recovered, deleted, or relabeled) must
+        # not pin a stale rejection against a future failure.
+        for gid in list(self._recovery_rejections):
+            if gid not in failed_ids:
+                del self._recovery_rejections[gid]
 
     def process_validation_required_groups(
         self, state: ClusterUpgradeState, validation_active: Optional[bool] = None

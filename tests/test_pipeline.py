@@ -186,7 +186,10 @@ def test_pipeline_validation_timeout_recordons():
             assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
             assert c.get_node(n.name, cached=False).spec.unschedulable
     # Once the gate passes (slice genuinely healed), recovery proceeds.
+    # (Recovery probes are rate-limited after a rejection; drop the
+    # backoff so the healed verdict is observed on the next pass.)
     mgr.validation_manager.prober = SlowProber(ticks=0)
+    mgr.recovery_probe_backoff_s = 0.0
     for _ in range(3):
         mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
     for n in nodes:

@@ -725,6 +725,34 @@ class TestPodRestartToDone:
             == UpgradeState.UNCORDON_REQUIRED.value
         )
 
+    def test_failed_group_recovery_probe_is_throttled(self):
+        """A rejected recovery probe is cached for the backoff window:
+        the full battery must not re-run inside every reconcile pass
+        (ADVICE r2: LocalDeviceProber's sustained battery ran
+        synchronously in the loop, unthrottled)."""
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set(hash_suffix="h2", revision=2)
+        n = fx.node(state=UpgradeState.FAILED)
+        fx.driver_pod(n, ds, hash_suffix="h2")
+        prober = FakeProber(healthy=False)
+        mgr = make_manager(c).with_validation_enabled(prober)
+        for _ in range(5):
+            mgr.apply_state(build(mgr), auto_policy())
+        assert prober.calls == 1  # throttled: one probe, not five
+        assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
+        # Backoff expiry -> re-probe; healthy verdict recovers the group
+        # and clears the cached rejection.
+        mgr.recovery_probe_backoff_s = 0.0
+        prober.healthy = True
+        mgr.apply_state(build(mgr), auto_policy())
+        assert prober.calls == 2
+        assert (
+            state_of(c, KEYS, n.name)
+            == UpgradeState.UNCORDON_REQUIRED.value
+        )
+        assert not mgr._recovery_rejections
+
     def test_initially_cordoned_node_skips_uncordon(self):
         c = FakeCluster()
         fx = ClusterFixture(c)
