@@ -34,3 +34,4 @@ from k8s_operator_libs_tpu.k8s.rest import (  # noqa: F401
     RestClient,
     get_default_client,
 )
+from k8s_operator_libs_tpu.k8s.apiserver import KubeApiServer  # noqa: F401

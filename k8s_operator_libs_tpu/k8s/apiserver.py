@@ -1,0 +1,475 @@
+"""A conformant in-process Kubernetes apiserver for the REST tier.
+
+The reference proves its engine against a REAL kube-apiserver via envtest
+(upgrade_suit_test.go:77-82: apiserver + etcd binaries, no kubelet).  No
+Kubernetes control-plane binaries exist in this environment, so this
+module provides the equivalent verification boundary the stdlib way: an
+HTTP server that speaks the exact wire subset ``rest.RestClient`` uses —
+typed-object JSON, strategic-merge/merge patches with ``null``-deletes,
+label/field selectors, list envelopes, Status error bodies, the policy/v1
+Eviction subresource with PodDisruptionBudget 429 semantics — backed by
+the same object store the simulation tier uses.
+
+What this buys over calling FakeCluster directly: the full
+serialize → HTTP → parse → verb → serialize → parse round trip runs for
+every engine call, so a field the client forgets to serialize, a patch
+content-type mismatch, or a Status body the client can't classify fails a
+test instead of surfacing on a real cluster.  The e2e rolling-upgrade
+suite runs unchanged against (engine → RestClient → this server), and a
+shared conformance suite pins FakeCluster and RestClient-over-server to
+identical verb semantics (tests/test_apiserver_tier.py).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import (
+    ConflictError,
+    EvictionBlockedError,
+    FakeCluster,
+    NotFoundError,
+)
+from k8s_operator_libs_tpu.k8s.objects import (
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    Pod,
+)
+from k8s_operator_libs_tpu.k8s.rest import daemon_set_from_json
+
+logger = get_logger(__name__)
+
+
+# --- typed object -> JSON (the server side of rest.py's *_from_json) --------
+
+
+def _iso(ts: Optional[float]) -> Optional[str]:
+    if ts is None:
+        return None
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+def _meta_to_json(meta) -> dict:
+    out = {
+        "name": meta.name,
+        "uid": meta.uid,
+        "resourceVersion": str(meta.resource_version),
+        "labels": dict(meta.labels),
+        "annotations": dict(meta.annotations),
+        "creationTimestamp": _iso(meta.creation_timestamp),
+    }
+    if meta.namespace:
+        out["namespace"] = meta.namespace
+    if meta.deletion_timestamp is not None:
+        out["deletionTimestamp"] = _iso(meta.deletion_timestamp)
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {
+                "name": o.name,
+                "uid": o.uid,
+                "kind": o.kind,
+                "apiVersion": "apps/v1",
+                "controller": o.controller,
+            }
+            for o in meta.owner_references
+        ]
+    return out
+
+
+def node_to_json(node: Node) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": _meta_to_json(node.metadata),
+        "spec": {"unschedulable": node.spec.unschedulable},
+        "status": {
+            "conditions": [
+                {"type": c.type, "status": c.status}
+                for c in node.status.conditions
+            ]
+        },
+    }
+
+
+def pod_to_json(pod: Pod) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": _meta_to_json(pod.metadata),
+        "spec": {
+            "nodeName": pod.spec.node_name,
+            "volumes": [
+                {"name": v.name, **({"emptyDir": {}} if v.empty_dir else {})}
+                for v in pod.spec.volumes
+            ],
+        },
+        "status": {
+            "phase": pod.status.phase,
+            "containerStatuses": [
+                {
+                    "name": c.name,
+                    "ready": c.ready,
+                    "restartCount": c.restart_count,
+                }
+                for c in pod.status.container_statuses
+            ],
+            "initContainerStatuses": [
+                {
+                    "name": c.name,
+                    "ready": c.ready,
+                    "restartCount": c.restart_count,
+                }
+                for c in pod.status.init_container_statuses
+            ],
+        },
+    }
+
+
+def daemon_set_to_json_full(ds: DaemonSet) -> dict:
+    """Server-side DS rendering: unlike the client's create/update body
+    (rest.daemon_set_to_json) this carries uid/resourceVersion and the
+    status the engine's completeness guard reads
+    (DesiredNumberScheduled, upgrade_state.go:243-246)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": _meta_to_json(ds.metadata),
+        "spec": {
+            "selector": {"matchLabels": dict(ds.spec.selector.match_labels)},
+            "updateStrategy": {"type": "OnDelete"},
+            "template": {
+                "metadata": {
+                    "labels": dict(ds.spec.template.labels),
+                    "annotations": dict(ds.spec.template.annotations),
+                },
+                "spec": dict(ds.spec.template.pod_spec),
+            },
+        },
+        "status": {
+            "desiredNumberScheduled": ds.status.desired_number_scheduled
+        },
+    }
+
+
+def controller_revision_to_json(rev: ControllerRevision) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ControllerRevision",
+        "metadata": _meta_to_json(rev.metadata),
+        "revision": rev.revision,
+    }
+
+
+def _status_body(
+    code: int, reason: str, message: str, causes: Optional[list] = None
+) -> dict:
+    body = {
+        "apiVersion": "v1",
+        "kind": "Status",
+        "status": "Failure",
+        "code": code,
+        "reason": reason,
+        "message": message,
+    }
+    if causes:
+        body["details"] = {"causes": causes}
+    return body
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes the API subset rest.RestClient speaks onto the store."""
+
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-operator-apiserver/1.0"
+
+    # Set by KubeApiServer.
+    store: FakeCluster = None  # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib logging
+        logger.debug("apiserver: " + fmt, *args)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_body(self) -> dict:
+        if not self._raw_body:
+            return {}
+        return json.loads(self._raw_body)
+
+    def _route(self, method: str) -> None:
+        url = urllib.parse.urlsplit(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        query = dict(urllib.parse.parse_qsl(url.query))
+        # Always drain the request body up front: a handler that ignores
+        # it (e.g. the Eviction subresource) would otherwise leave bytes
+        # in the socket and desync the next keep-alive request.
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        self._raw_body = self.rfile.read(length) if length else b""
+        try:
+            self._dispatch(method, parts, query)
+        except NotFoundError as e:
+            self._send(404, _status_body(404, "NotFound", str(e)))
+        except ConflictError as e:
+            self._send(409, _status_body(409, "AlreadyExists", str(e)))
+        except EvictionBlockedError as e:
+            self._send(
+                429,
+                _status_body(
+                    429,
+                    "TooManyRequests",
+                    f"Cannot evict pod as it would violate the pod's "
+                    f"disruption budget: {e}",
+                    causes=[{"reason": "DisruptionBudget", "message": str(e)}],
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — surface as 500, don't die
+            logger.exception("apiserver handler error")
+            self._send(
+                500, _status_body(500, "InternalError", f"{type(e).__name__}: {e}")
+            )
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
+        label_selector = query.get("labelSelector", "")
+        # /api/v1/nodes[/{name}]
+        if parts[:2] == ["api", "v1"] and len(parts) >= 3 and parts[2] == "nodes":
+            if len(parts) == 3 and method == "GET":
+                items = self.store.list_nodes(label_selector=label_selector)
+                return self._send(
+                    200,
+                    {
+                        "apiVersion": "v1",
+                        "kind": "NodeList",
+                        "items": [node_to_json(n) for n in items],
+                    },
+                )
+            name = parts[3]
+            if method == "GET":
+                return self._send(
+                    200, node_to_json(self.store.get_node(name, cached=False))
+                )
+            if method == "PATCH":
+                return self._patch_node(name)
+        # /api/v1/pods and /api/v1/namespaces/{ns}/pods[/{name}[/eviction]]
+        if parts[:2] == ["api", "v1"]:
+            if parts[2:] == ["pods"] and method == "GET":
+                return self._list_pods("", query)
+            if len(parts) >= 5 and parts[2] == "namespaces" and parts[4] == "pods":
+                ns = parts[3]
+                if len(parts) == 5 and method == "GET":
+                    return self._list_pods(ns, query)
+                name = parts[5]
+                if len(parts) == 6 and method == "GET":
+                    return self._send(
+                        200, pod_to_json(self.store.get_pod(ns, name))
+                    )
+                if len(parts) == 6 and method == "DELETE":
+                    self.store.delete_pod(ns, name)
+                    return self._send(
+                        200, _status_body(200, "Success", "deleted")
+                    )
+                if (
+                    len(parts) == 7
+                    and parts[6] == "eviction"
+                    and method == "POST"
+                ):
+                    self.store.evict_pod(ns, name)
+                    return self._send(
+                        201, _status_body(201, "Success", "evicted")
+                    )
+        # /apis/apps/v1/[namespaces/{ns}/]daemonsets|controllerrevisions
+        if parts[:3] == ["apis", "apps", "v1"]:
+            rest_parts = parts[3:]
+            ns = ""
+            if rest_parts[:1] == ["namespaces"]:
+                ns = rest_parts[1]
+                rest_parts = rest_parts[2:]
+            if rest_parts[:1] == ["daemonsets"]:
+                return self._daemonsets(method, ns, rest_parts[1:], query)
+            if rest_parts[:1] == ["controllerrevisions"] and method == "GET":
+                items = self.store.list_controller_revisions(
+                    namespace=ns, label_selector=label_selector
+                )
+                return self._send(
+                    200,
+                    {
+                        "apiVersion": "apps/v1",
+                        "kind": "ControllerRevisionList",
+                        "items": [
+                            controller_revision_to_json(r) for r in items
+                        ],
+                    },
+                )
+        raise NotFoundError(f"no route for {method} {'/'.join(parts)}")
+
+    # -- verb implementations ------------------------------------------------
+
+    def _patch_node(self, name: str) -> None:
+        body = self._read_body()
+        meta = body.get("metadata") or {}
+        spec = body.get("spec") or {}
+        node = None
+        # Strategic-merge and JSON-merge coincide for flat string maps:
+        # merge keys, null deletes (node_upgrade_state_provider.go:147's
+        # "null" convention arrives here as real JSON null).
+        if "labels" in meta:
+            node = self.store.patch_node_labels(name, meta["labels"])
+        if "annotations" in meta:
+            node = self.store.patch_node_annotations(name, meta["annotations"])
+        if "unschedulable" in spec:
+            node = self.store.set_node_unschedulable(
+                name, bool(spec["unschedulable"])
+            )
+        if node is None:
+            # Patch touched nothing this server models: a real apiserver
+            # applies the no-op merge and returns the object.
+            node = self.store.get_node(name, cached=False)
+        self._send(200, node_to_json(node))
+
+    def _list_pods(self, namespace: str, query: dict) -> None:
+        field_selector = query.get("fieldSelector", "")
+        node_name = None
+        for clause in field_selector.split(","):
+            if clause.startswith("spec.nodeName="):
+                node_name = clause.split("=", 1)[1]
+        items = self.store.list_pods(
+            namespace=namespace,
+            label_selector=query.get("labelSelector", ""),
+            node_name=node_name,
+        )
+        self._send(
+            200,
+            {
+                "apiVersion": "v1",
+                "kind": "PodList",
+                "items": [pod_to_json(p) for p in items],
+            },
+        )
+
+    def _daemonsets(
+        self, method: str, ns: str, rest_parts: list[str], query: dict
+    ) -> None:
+        if not rest_parts:
+            if method == "GET":
+                selector = query.get("labelSelector", "")
+                match_labels = {}
+                for clause in selector.split(","):
+                    if "=" in clause:
+                        k, _, v = clause.partition("=")
+                        match_labels[k] = v
+                items = self.store.list_daemon_sets(
+                    namespace=ns, match_labels=match_labels or None
+                )
+                return self._send(
+                    200,
+                    {
+                        "apiVersion": "apps/v1",
+                        "kind": "DaemonSetList",
+                        "items": [daemon_set_to_json_full(d) for d in items],
+                    },
+                )
+            if method == "POST":
+                ds = daemon_set_from_json(self._read_body())
+                ds.metadata.namespace = ds.metadata.namespace or ns
+                created = self.store.create_daemon_set(ds)
+                return self._send(201, daemon_set_to_json_full(created))
+        else:
+            name = rest_parts[0]
+            if method == "GET":
+                return self._send(
+                    200,
+                    daemon_set_to_json_full(self.store.get_daemon_set(ns, name)),
+                )
+            if method == "PUT":
+                ds = daemon_set_from_json(self._read_body())
+                ds.metadata.namespace = ds.metadata.namespace or ns
+                ds.metadata.name = ds.metadata.name or name
+                # Preserve identity/status across the wire update: the
+                # client's update body intentionally omits server-owned
+                # fields (uid, status), exactly like a real apiserver
+                # merges them.
+                current = self.store.get_daemon_set(ns, name)
+                ds.metadata.uid = current.metadata.uid
+                ds.status = current.status
+                updated = self.store.update_daemon_set(ds)
+                return self._send(200, daemon_set_to_json_full(updated))
+        raise NotFoundError(f"no daemonset route {method} {rest_parts}")
+
+    # -- stdlib verb entrypoints ---------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._route("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._route("POST")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._route("PUT")
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        self._route("PATCH")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._route("DELETE")
+
+
+class KubeApiServer:
+    """A threaded HTTP apiserver over a FakeCluster object store.
+
+    The store is constructed with zero injected latency/cache-lag: a REST
+    read against a real apiserver is a quorum read, and the engine's
+    write-then-poll cache loop must converge on the first poll
+    (rest.RestClient.get_node notes the same).
+    """
+
+    def __init__(self, store: Optional[FakeCluster] = None, port: int = 0):
+        self.store = store if store is not None else FakeCluster()
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "KubeApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def __enter__(self) -> "KubeApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
