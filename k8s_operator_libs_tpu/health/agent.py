@@ -38,14 +38,25 @@ NODE_NAME_ENV = "NODE_NAME"
 DRIVER_REVISION_ENV = "DRIVER_REVISION"
 
 
-def maybe_initialize_distributed() -> bool:
+# GKE's TPU coordinator port convention (worker 0 hosts the jax
+# coordination service; jax's own GkeTpuCluster detector uses the same).
+GKE_COORDINATOR_PORT = 8476
+
+
+def maybe_initialize_distributed(backend: Optional[str] = None) -> bool:
     """Initialize ``jax.distributed`` when multi-host env is present.
 
-    GKE TPU pods are injected with ``TPU_WORKER_HOSTNAMES`` (and
-    megascale coordinator env on multi-slice); jax.distributed.initialize
-    auto-detects the TPU cluster from those.  An explicit coordinator
-    address is also honored.  Returns True when the process participates
-    in a multi-process JAX runtime (then ``jax.devices()`` spans the whole
+    GKE TPU pods are injected with ``TPU_WORKER_HOSTNAMES`` +
+    ``TPU_WORKER_ID`` (and megascale coordinator env on multi-slice).
+    When those fully determine the cluster (>1 hostname and a worker id)
+    we initialize EXPLICITLY — coordinator = worker 0, process_id =
+    worker id — with jax's own environment auto-detection deactivated,
+    so a partially-matching cloud environment can't override the
+    contract.  An explicit coordinator address alone falls back to jax
+    auto-detection for the remaining parameters.
+
+    Returns True when the process participates in a multi-process JAX
+    runtime for ``backend`` (then ``jax.devices()`` spans the whole
     slice and the ICI all-reduce probe is the re-formation check)."""
     hostnames = [
         h
@@ -55,16 +66,34 @@ def maybe_initialize_distributed() -> bool:
     explicit = (
         os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("COORDINATOR_ADDRESS")
-        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
     )
-    if explicit or len(hostnames) > 1:
+    # Multi-slice (megascale): TPU_WORKER_HOSTNAMES/TPU_WORKER_ID are
+    # PER-SLICE, so the explicit branch below would compute a wrong
+    # global topology (duplicate process_ids across slices, per-slice
+    # num_processes) — only jax's own cluster detection knows how to
+    # offset by slice id.  Never use the megascale (DCN) coordinator as
+    # the jax coordination service address.
+    megascale = bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if explicit or megascale or len(hostnames) > 1:
+        kwargs: dict = {}
+        worker_id = os.environ.get("TPU_WORKER_ID", "")
+        if not megascale and len(hostnames) > 1 and worker_id.isdigit():
+            kwargs = {
+                "coordinator_address": (
+                    explicit
+                    or f"{hostnames[0]}:{GKE_COORDINATOR_PORT}"
+                ),
+                "num_processes": len(hostnames),
+                "process_id": int(worker_id),
+                "cluster_detection_method": "deactivate",
+            }
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(**kwargs)
         except RuntimeError as e:
             # Already initialized (idempotent re-entry) is fine.
             if "already" not in str(e).lower():
                 raise
-    return jax.process_count() > 1
+    return jax.process_count(backend) > 1
 
 
 class HealthAgent:

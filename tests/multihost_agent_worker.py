@@ -1,0 +1,77 @@
+"""One TPU-host probe agent under ``jax.distributed`` (spawned process).
+
+Spawned by ``test_multihost_agent.py``, twice, to execute the production
+multi-host agent path with REAL cross-process collectives: the test sets
+the GKE-shaped env (``TPU_WORKER_HOSTNAMES``, ``TPU_WORKER_ID``,
+coordinator address), this worker initializes ``jax.distributed`` through
+``maybe_initialize_distributed``, runs the probe battery over the
+process-spanning CPU mesh (gloo collectives), and publishes its
+slice-wide HealthReport through RestClient → KubeApiServer — the exact
+agent-pod → apiserver shape of production.
+
+Prints one JSON line on stdout for the test to assert on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# Cross-process collectives on the CPU backend need an explicit
+# implementation; must be set before the CPU client is instantiated.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from k8s_operator_libs_tpu.health.agent import (  # noqa: E402
+    HealthAgent,
+    maybe_initialize_distributed,
+)
+from k8s_operator_libs_tpu.k8s import KubeConfig, RestClient  # noqa: E402
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys  # noqa: E402
+
+
+def main() -> None:
+    slice_wide = maybe_initialize_distributed(backend="cpu")
+    devices = jax.devices("cpu")
+    client = RestClient(
+        KubeConfig(host=os.environ["TEST_APISERVER_HOST"]), timeout_s=10.0
+    )
+    agent = HealthAgent(
+        client,
+        node_name=os.environ["NODE_NAME"],
+        keys=UpgradeKeys(),
+        driver_revision=os.environ.get("DRIVER_REVISION", ""),
+        devices=devices,
+        slice_wide=slice_wide,
+        matmul_n=64,
+        hbm_mib=1,
+        allreduce_elems=256,
+        deep=os.environ.get("HEALTH_DEEP_PROBE", "") == "1",
+    )
+    report = agent.run_once()
+    print(
+        json.dumps(
+            {
+                "node": agent.node_name,
+                "process_count": jax.process_count("cpu"),
+                "slice_wide": report.slice_wide,
+                "visible_devices": report.visible_devices,
+                "healthy": report.healthy,
+                "checks": {c.name: c.ok for c in report.checks},
+                "failed": [
+                    f"{c.name}: {c.detail}"
+                    for c in report.checks
+                    if not c.ok
+                ],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
