@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import Counter, defaultdict
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 from k8s_operator_libs_tpu.k8s.objects import (
     ControllerRevision,

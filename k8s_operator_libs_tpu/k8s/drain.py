@@ -27,7 +27,6 @@ from k8s_operator_libs_tpu.k8s.client import (
     ThrottledError,
 )
 from k8s_operator_libs_tpu.k8s.objects import Node, Pod
-from k8s_operator_libs_tpu.k8s.selectors import matches_selector
 
 
 class DrainError(RuntimeError):

@@ -16,7 +16,6 @@ for all waiting hosts at once, only after the entire slice is quiesced.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.objects import Node

@@ -10,10 +10,7 @@ import time
 import pytest
 
 from k8s_operator_libs_tpu.k8s import (
-    ContainerStatus,
-    ControllerRevision,
     DaemonSet,
-    DrainError,
     DrainHelper,
     FakeCluster,
     Node,

@@ -15,7 +15,6 @@ from k8s_operator_libs_tpu.api import (
 )
 from k8s_operator_libs_tpu.k8s import FakeCluster
 from k8s_operator_libs_tpu.upgrade import (
-    ClusterUpgradeStateManager,
     NodeUpgradeStateProvider,
     UpgradeKeys,
     UpgradeState,

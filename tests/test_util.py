@@ -9,7 +9,6 @@ from k8s_operator_libs_tpu.upgrade.util import (
     KeyedMutex,
     StringSet,
     UpgradeKeys,
-    default_keys,
     get_upgrade_state_label_key,
     log_event,
     set_driver_name,

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Self-contained linter (reference parity: golangci-lint gates CI,
+.golangci.yaml:15 — no Python linter is installable in every environment
+this repo builds in, so the gate ships with the repo).
+
+Checks, all hard failures (exit 1):
+
+- **syntax**: every file must parse;
+- **F401 unused imports**: an imported name never referenced in the
+  module (``# noqa`` / ``# noqa: F401`` on the import line exempts;
+  ``__init__.py`` re-export surfaces rely on that, same as pyflakes);
+- **F821 undefined names**: a name the compiler resolves as an implicit
+  global that is neither a module global, a builtin, nor a wildcard
+  import — the "typo in an error path" class golangci's typecheck
+  catches (uses the real symtable, so comprehension/closure scopes
+  resolve correctly);
+- **E722 bare except**;
+- **B006 mutable default arguments** (list/dict/set literals or calls).
+
+Usage: ``python tools/lint.py PATH [PATH...]`` — directories recurse.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import sys
+import symtable
+import tokenize
+
+
+def _noqa_lines(path: str) -> dict[int, set[str]]:
+    """line -> set of silenced codes ('*' = all) from ``# noqa`` comments."""
+    out: dict[int, set[str]] = {}
+    try:
+        with tokenize.open(path) as f:
+            tokens = tokenize.generate_tokens(f.readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                comment = tok.string
+                if "noqa" not in comment.lower():
+                    continue
+                _, _, codes = comment.lower().partition("noqa")
+                codes = codes.lstrip(":").strip()
+                if codes:
+                    out[tok.start[0]] = {
+                        c.strip().upper()
+                        for c in codes.replace(",", " ").split()
+                    }
+                else:
+                    out[tok.start[0]] = {"*"}
+    except (OSError, tokenize.TokenizeError, SyntaxError):
+        pass
+    return out
+
+
+def _silenced(noqa: dict[int, set[str]], line: int, code: str) -> bool:
+    codes = noqa.get(line)
+    if not codes:
+        return False
+    # Codes may be pyflakes-style (F401) or prose ('F401 — re-export');
+    # match on the bare code or a wildcard.
+    return "*" in codes or any(code in c for c in codes)
+
+
+class _Findings:
+    def __init__(self) -> None:
+        self.items: list[str] = []
+
+    def add(self, path: str, line: int, code: str, msg: str) -> None:
+        self.items.append(f"{path}:{line}: {code} {msg}")
+
+
+def _module_scope_names(tree: ast.Module) -> set[str]:
+    """Names bound at module scope (incl. conditional/try branches)."""
+    names: set[str] = set()
+
+    def bind_target(t: ast.AST) -> None:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                names.add(node.id)
+
+    def visit_body(body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    names.add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                names.add(stmt.name)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    bind_target(t)
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                bind_target(stmt.target)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                bind_target(stmt.target)
+                visit_body(stmt.body)
+                visit_body(stmt.orelse)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                visit_body(stmt.body)
+                visit_body(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                visit_body(stmt.body)
+                for h in stmt.handlers:
+                    if h.name:
+                        names.add(h.name)
+                    visit_body(h.body)
+                visit_body(stmt.orelse)
+                visit_body(stmt.finalbody)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        bind_target(item.optional_vars)
+                visit_body(stmt.body)
+            elif isinstance(stmt, ast.Delete):
+                pass
+
+    visit_body(tree.body)
+    return names
+
+
+def _has_star_import(tree: ast.Module) -> bool:
+    return any(
+        isinstance(s, ast.ImportFrom)
+        and any(a.name == "*" for a in s.names)
+        for s in ast.walk(tree)
+    )
+
+
+def _check_unused_imports(
+    path: str, tree: ast.Module, noqa: dict[int, set[str]], out: _Findings
+) -> None:
+    imported: dict[str, tuple[int, str]] = {}
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                name = alias.asname or alias.name.split(".")[0]
+                imported[name] = (stmt.lineno, alias.name)
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.module == "__future__":
+                continue  # compiler directive, not a binding to "use"
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                imported[name] = (stmt.lineno, alias.name)
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # Names exported via a literal __all__ count as used.
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in stmt.targets
+            )
+            and isinstance(stmt.value, (ast.List, ast.Tuple))
+        ):
+            for elt in stmt.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    used.add(elt.value)
+    for name, (line, target) in sorted(imported.items()):
+        if name in used or name == "_":
+            continue
+        if _silenced(noqa, line, "F401"):
+            continue
+        out.add(path, line, "F401", f"'{target}' imported but unused")
+
+
+def _check_undefined_names(
+    path: str, src: str, tree: ast.Module, noqa: dict[int, set[str]],
+    out: _Findings,
+) -> None:
+    if _has_star_import(tree):
+        return  # cannot resolve; same concession pyflakes makes
+    module_names = _module_scope_names(tree)
+    known = module_names | set(dir(builtins)) | {
+        "__file__", "__name__", "__doc__", "__package__", "__spec__",
+        "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+    }
+    try:
+        table = symtable.symtable(src, path, "exec")
+    except SyntaxError:
+        return
+    # Walk nested scopes; flag implicit globals unknown at module scope.
+    # Line attribution: find a Name node matching in the scope's range.
+    name_lines: dict[str, list[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            name_lines.setdefault(node.id, []).append(node.lineno)
+
+    reported: set[tuple[str, int]] = set()
+
+    def walk(scope: symtable.SymbolTable) -> None:
+        for sym in scope.get_symbols():
+            name = sym.get_name()
+            if name in known or not sym.is_referenced():
+                continue
+            if sym.is_local() or sym.is_parameter() or sym.is_imported():
+                continue
+            if getattr(sym, "is_free", lambda: False)():
+                continue
+            if sym.is_declared_global() or sym.is_global():
+                lines = name_lines.get(name, [scope.get_lineno()])
+                line = lines[0]
+                key = (name, line)
+                if key in reported or _silenced(noqa, line, "F821"):
+                    continue
+                reported.add(key)
+                out.add(path, line, "F821", f"undefined name '{name}'")
+        for child in scope.get_children():
+            walk(child)
+
+    # Module scope itself: loads of unknown names.
+    for sym in table.get_symbols():
+        name = sym.get_name()
+        if name in known or not sym.is_referenced():
+            continue
+        if sym.is_imported() or sym.is_assigned():
+            continue
+        lines = name_lines.get(name, [1])
+        line = lines[0]
+        if not _silenced(noqa, line, "F821"):
+            key = (name, line)
+            if key not in reported:
+                reported.add(key)
+                out.add(path, line, "F821", f"undefined name '{name}'")
+    for child in table.get_children():
+        walk(child)
+
+
+def _check_misc(
+    path: str, tree: ast.Module, noqa: dict[int, set[str]], out: _Findings
+) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not _silenced(noqa, node.lineno, "E722"):
+                out.add(path, node.lineno, "E722", "bare 'except:'")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    if not _silenced(noqa, d.lineno, "B006"):
+                        out.add(
+                            path, d.lineno, "B006",
+                            f"mutable default argument in '{node.name}'",
+                        )
+
+
+def lint_file(path: str, out: _Findings) -> None:
+    try:
+        with tokenize.open(path) as f:
+            src = f.read()
+    except (OSError, SyntaxError) as e:
+        out.add(path, 0, "E902", f"cannot read: {e}")
+        return
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        out.add(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")
+        return
+    noqa = _noqa_lines(path)
+    _check_unused_imports(path, tree, noqa, out)
+    _check_undefined_names(path, src, tree, noqa, out)
+    _check_misc(path, tree, noqa, out)
+
+
+def main(argv: list[str]) -> int:
+    paths: list[str] = []
+    for arg in argv or ["."]:
+        if os.path.isdir(arg):
+            for root, dirs, files in os.walk(arg):
+                dirs[:] = [
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                ]
+                paths.extend(
+                    os.path.join(root, f) for f in files if f.endswith(".py")
+                )
+        elif arg.endswith(".py"):
+            paths.append(arg)
+    out = _Findings()
+    for path in sorted(paths):
+        lint_file(path, out)
+    for item in out.items:
+        print(item)
+    print(
+        f"lint: {len(paths)} files, {len(out.items)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if out.items else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
