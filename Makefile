@@ -30,7 +30,7 @@ lint:
 
 # Line coverage via the in-repo sys.monitoring runner; fails the build
 # under the threshold (reference parity: ci.yaml:50-66 coverage gate).
-COV_THRESHOLD ?= 70
+COV_THRESHOLD ?= 85
 cov-report:
 	$(PYTHON) tools/cover.py --threshold $(COV_THRESHOLD) --report \
 		-- tests/ -q

@@ -437,7 +437,16 @@ def main() -> None:
         thread.start()
         result = harness.run()
         stop.set()
-        thread.join(5.0)
+        # The runner is SHARED across rolls: a leftover thread would race
+        # the next roll's loop on the same donated-buffer jit and append
+        # stale timestamps into its reset timing window.  One step can
+        # take seconds on slow backends — wait it out, and refuse to
+        # continue if the thread is somehow wedged.
+        thread.join(120.0)
+        if thread.is_alive():
+            raise RuntimeError(
+                "canary thread did not stop; measurements would be corrupt"
+            )
         end = time.monotonic()
         still_down = harness.slice_disrupted(0)
         downtime = canary.max_gap_seconds(

@@ -1,0 +1,372 @@
+"""Direct tests for previously thin surfaces: RestClient internals, the
+controller loop + CLI helpers, the metrics server's error path, and the
+safe-load init container entrypoint."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
+from k8s_operator_libs_tpu.controller import (
+    ControllerConfig,
+    UpgradeController,
+    _parse_labels,
+)
+from k8s_operator_libs_tpu.driver.safe_load_init import main as safe_load_main
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    KubeConfig,
+    RestClient,
+)
+from k8s_operator_libs_tpu.k8s.client import ThrottledError
+from k8s_operator_libs_tpu.k8s.rest import daemon_set_from_json, daemon_set_to_json
+from k8s_operator_libs_tpu.metrics import MetricsRegistry, MetricsServer
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+KEYS = UpgradeKeys()
+
+
+# --- RestClient internals ----------------------------------------------------
+
+
+def test_token_refresh_from_file(tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-1\n")
+    client = RestClient(
+        KubeConfig(host="http://127.0.0.1:1", token_path=str(token_file))
+    )
+    assert client._current_token() == "tok-1"
+    token_file.write_text("tok-2\n")
+    # Within the refresh interval the cached token is served.
+    assert client._current_token() == "tok-1"
+    client._token_read_at = time.monotonic() - RestClient.TOKEN_REFRESH_S - 1
+    assert client._current_token() == "tok-2"
+    # A vanished token file keeps the last good token (warn, don't break).
+    token_file.unlink()
+    client._token_read_at = time.monotonic() - RestClient.TOKEN_REFRESH_S - 1
+    assert client._current_token() == "tok-2"
+
+
+def test_is_pdb_rejection_variants():
+    causes = json.dumps(
+        {"details": {"causes": [{"reason": "DisruptionBudget"}]}}
+    ).encode()
+    message = json.dumps(
+        {"message": "Cannot evict: disruption budget foo needs 2"}
+    ).encode()
+    assert RestClient._is_pdb_rejection(causes)
+    assert RestClient._is_pdb_rejection(message)
+    assert not RestClient._is_pdb_rejection(b"{}")
+    assert not RestClient._is_pdb_rejection(b"not json")
+    assert not RestClient._is_pdb_rejection(b"[1, 2]")
+
+
+def test_stat_key_bounded():
+    key = RestClient._stat_key
+    assert key("GET", "/api/v1/nodes/some-very-long-node-name") == "GET nodes"
+    assert key("POST", "/api/v1/namespaces/ns/pods/p1/eviction") == (
+        "POST eviction"
+    )
+    assert key("GET", "/apis/apps/v1/namespaces/ns/daemonsets") == (
+        "GET daemonsets"
+    )
+    assert key("GET", "/unknown/path") == "GET ?"
+
+
+class _StatusStub(ThreadingHTTPServer):
+    """Returns a fixed status for every request."""
+
+
+def _stub_server(status: int, headers: dict, body: bytes):
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self):
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_GET = do_POST = do_PATCH = do_DELETE = _respond
+
+        def log_message(self, *args):
+            pass
+
+    server = _StatusStub(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def test_throttled_and_server_error_classification():
+    server = _stub_server(429, {"Retry-After": "7"}, b"{}")
+    try:
+        client = RestClient(
+            KubeConfig(host=f"http://127.0.0.1:{server.server_address[1]}"),
+            timeout_s=5.0,
+        )
+        with pytest.raises(ThrottledError) as exc:
+            client.list_nodes()
+        assert exc.value.retry_after_s == 7.0
+    finally:
+        server.shutdown()
+    server = _stub_server(500, {}, b"boom")
+    try:
+        client = RestClient(
+            KubeConfig(host=f"http://127.0.0.1:{server.server_address[1]}"),
+            timeout_s=5.0,
+        )
+        with pytest.raises(RuntimeError, match="-> 500"):
+            client.get_node("n1")
+    finally:
+        server.shutdown()
+
+
+def test_daemon_set_json_round_trip():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    ds.spec.template.pod_spec = {"containers": [{"name": "drv", "image": "i:1"}]}
+    parsed = daemon_set_from_json(daemon_set_to_json(ds))
+    assert parsed.name == ds.name
+    assert parsed.spec.selector.match_labels == DRIVER_LABELS
+    assert parsed.spec.template.pod_spec["containers"][0]["image"] == "i:1"
+
+
+# --- controller loop + CLI helpers ------------------------------------------
+
+
+def test_parse_labels():
+    assert _parse_labels("a=b, c = d ,,e=") == {"a": "b", "c": "d", "e": ""}
+    assert _parse_labels("") == {}
+
+
+def test_run_forever_reconciles_and_survives_stop():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    node = fx.tpu_node("pool-a", 0)
+    fx.driver_pod(node, ds, hash_suffix="h1")
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        interval_s=0.01,
+        policy=TPUUpgradePolicySpec(
+            auto_upgrade=False,  # observe-only loop
+            drain_spec=DrainSpec(enable=True, timeout_second=1),
+        ),
+        metrics_port=0,
+        hbm_floor_fraction=0.0,
+    )
+    controller = UpgradeController(cluster, config)
+    thread = threading.Thread(target=controller.run_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if "nodes_total" in controller.registry.render():
+                break
+            time.sleep(0.05)
+        text = controller.registry.render()
+        assert "tpu_operator_reconcile_duration_seconds" in text
+    finally:
+        controller.stop()
+        thread.join(10.0)
+    assert not thread.is_alive()
+
+
+def test_reconcile_once_requeues_on_incoherent_snapshot():
+    """DS exists but a driver pod is missing -> BuildStateError -> False
+    (requeue), loop does not crash (reference reconcile-error semantics)."""
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    node = fx.tpu_node("pool-a", 0)
+    fx.driver_pod(node, ds, hash_suffix="h1")
+    ds.status.desired_number_scheduled = 2  # claims one more pod than exists
+    cluster.update_daemon_set(ds)
+    controller = UpgradeController(
+        cluster,
+        ControllerConfig(
+            namespace=NAMESPACE, driver_labels=DRIVER_LABELS,
+            policy=TPUUpgradePolicySpec(auto_upgrade=True),
+        ),
+    )
+    assert controller.reconcile_once() is False
+
+
+# --- metrics server error path ----------------------------------------------
+
+
+def test_metrics_server_404():
+    registry = MetricsRegistry()
+    server = MetricsServer(registry, port=0)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/other", timeout=5
+            )
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+
+
+# --- safe-load init container entrypoint ------------------------------------
+
+
+def test_safe_load_main_end_to_end(monkeypatch):
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("host-9")
+    monkeypatch.setenv("NODE_NAME", "host-9")
+    monkeypatch.setenv("SAFE_LOAD_POLL_S", "0.01")
+    import k8s_operator_libs_tpu.k8s as k8s_pkg
+
+    monkeypatch.setattr(k8s_pkg, "get_default_client", lambda: cluster)
+
+    def controller_side():
+        annotation = KEYS.safe_load_annotation
+        for _ in range(200):
+            n = cluster.get_node("host-9", cached=False)
+            if annotation in n.annotations:
+                cluster.patch_node_annotations("host-9", {annotation: None})
+                return
+            time.sleep(0.01)
+
+    thread = threading.Thread(target=controller_side)
+    thread.start()
+    safe_load_main()  # returns (exit 0 path) once unblocked
+    thread.join()
+
+
+def test_safe_load_main_requires_node_name(monkeypatch):
+    monkeypatch.delenv("NODE_NAME", raising=False)
+    with pytest.raises(SystemExit):
+        safe_load_main()
+
+
+# --- controller CLI entrypoint ----------------------------------------------
+
+
+def test_controller_main_wires_config(monkeypatch):
+    """CLI args land in ControllerConfig; the loop itself is stubbed."""
+    import k8s_operator_libs_tpu.controller as controller_mod
+    import k8s_operator_libs_tpu.k8s as k8s_pkg
+
+    cluster = FakeCluster()
+    monkeypatch.setattr(k8s_pkg, "get_default_client", lambda: cluster)
+    captured = {}
+
+    def fake_run(self):
+        captured["config"] = self.config
+        captured["client"] = self.client
+
+    monkeypatch.setattr(
+        controller_mod.UpgradeController, "run_forever", fake_run
+    )
+    controller_mod.main(
+        [
+            "--namespace", "drv-ns",
+            "--selector", "app=x,tier=driver",
+            "--driver-name", "libtpu",
+            "--interval", "7",
+            "--manage-daemonset",
+            "--driver-version", "9.9",
+        ]
+    )
+    cfg = captured["config"]
+    assert captured["client"] is cluster
+    assert cfg.namespace == "drv-ns"
+    assert cfg.driver_labels == {"app": "x", "tier": "driver"}
+    assert cfg.interval_s == 7.0
+    assert cfg.daemonset_spec is not None
+    assert cfg.daemonset_spec.version == "9.9"
+    assert cfg.policy.auto_upgrade  # default policy when no file given
+
+
+# --- health agent entrypoint + loop ------------------------------------------
+
+
+def test_agent_main_and_run_forever(monkeypatch, cpu_devices):
+    """agent.main wires env into a HealthAgent; run_forever publishes and
+    survives a failing probe cycle."""
+    import k8s_operator_libs_tpu.health.agent as agent_mod
+    import k8s_operator_libs_tpu.k8s as k8s_pkg
+
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("agent-host")
+    monkeypatch.setenv("NODE_NAME", "agent-host")
+    monkeypatch.setenv("DRIVER_REVISION", "rev-9")
+    monkeypatch.setenv("HEALTH_PROBE_INTERVAL_S", "0.01")
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setattr(k8s_pkg, "get_default_client", lambda: cluster)
+
+    published = threading.Event()
+    real_agent_cls = agent_mod.HealthAgent
+
+    class OneShotAgent(real_agent_cls):
+        def __init__(self, client, node_name, **kw):
+            super().__init__(
+                client, node_name, KEYS, driver_revision="rev-9",
+                devices=cpu_devices[:1], matmul_n=64, hbm_mib=1,
+                allreduce_elems=64,
+            )
+
+        def run_once(self):
+            report = super().run_once()
+            published.set()
+            raise KeyboardInterrupt  # break run_forever for the test
+
+        def run_forever(self, interval_s):
+            try:
+                super().run_forever(interval_s)
+            except KeyboardInterrupt:
+                pass
+
+    monkeypatch.setattr(agent_mod, "HealthAgent", OneShotAgent)
+    agent_mod.main()
+    assert published.is_set()
+    raw = cluster.get_node("agent-host", cached=False).annotations[
+        KEYS.health_report_annotation
+    ]
+    assert "rev-9" in raw
+
+
+def test_agent_run_forever_survives_probe_failure(monkeypatch, cpu_devices):
+    from k8s_operator_libs_tpu.health.agent import HealthAgent
+
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("h1")
+    agent = HealthAgent(
+        cluster, "h1", KEYS, devices=cpu_devices[:1],
+        matmul_n=64, hbm_mib=1, allreduce_elems=64,
+    )
+    calls = {"n": 0}
+    orig = agent.run_once
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient probe crash")
+        orig()
+        raise KeyboardInterrupt
+
+    agent.run_once = flaky
+    try:
+        agent.run_forever(interval_s=0.01)
+    except KeyboardInterrupt:
+        pass
+    # First cycle crashed, loop survived, second cycle published.
+    assert calls["n"] == 2
+    assert (
+        KEYS.health_report_annotation
+        in cluster.get_node("h1", cached=False).annotations
+    )
