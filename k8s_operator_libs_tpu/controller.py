@@ -35,6 +35,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
 )
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.driver.daemonset import (
+    AgentDaemonSetSpec,
     DriverDaemonSetSpec,
     DriverSetReconciler,
 )
@@ -65,6 +66,11 @@ class ControllerConfig:
     policy: Optional[DriverUpgradePolicySpec] = None
     # When set, the controller also owns the driver DaemonSet.
     daemonset_spec: Optional[DriverDaemonSetSpec] = None
+    # When set, the controller also owns the health-agent DaemonSet and
+    # keeps its DRIVER_REVISION env pinned to the driver's current
+    # ControllerRevision (agents restart and re-report on every driver
+    # template change).
+    agent_spec: Optional[AgentDaemonSetSpec] = None
     metrics_port: Optional[int] = None
     # Health-gate HBM floor as a fraction of the slice accelerator's
     # published spec bandwidth (hw.chip_spec).  0 disables the floor —
@@ -104,6 +110,11 @@ class UpgradeController:
             if config.daemonset_spec is not None
             else None
         )
+        self.agent_reconciler = (
+            DriverSetReconciler(client, config.agent_spec)
+            if config.agent_spec is not None
+            else None
+        )
         self.registry = MetricsRegistry()
         self.metrics = UpgradeMetrics(self.registry)
         self.slice_timer = SliceUpgradeTimer(self.registry)
@@ -117,6 +128,11 @@ class UpgradeController:
         t0 = time.monotonic()
         if self.ds_reconciler is not None:
             self.ds_reconciler.reconcile()
+        if self.agent_reconciler is not None:
+            self.config.agent_spec.driver_revision = (
+                self._current_driver_revision()
+            )
+            self.agent_reconciler.reconcile()
         try:
             state = self.manager.build_state(
                 self.config.namespace,
@@ -139,6 +155,24 @@ class UpgradeController:
                 ev.message,
             )
         return True
+
+    def _current_driver_revision(self) -> str:
+        """Current ControllerRevision hash of the (first) driver
+        DaemonSet matching the selector, or "" when the DaemonSet is
+        absent OR has no recorded revision yet (a just-created DS: the
+        DS controller hasn't written its first ControllerRevision)."""
+        daemon_sets = self.client.list_daemon_sets(
+            namespace=self.config.namespace,
+            match_labels=self.config.driver_labels,
+        )
+        if not daemon_sets:
+            return ""
+        try:
+            return self.manager.pod_manager.get_daemonset_controller_revision_hash(
+                daemon_sets[0]
+            )
+        except ValueError:
+            return ""
 
     def stop(self, *_args) -> None:
         self._stop = True
@@ -205,8 +239,20 @@ def main(argv: Optional[list[str]] = None) -> None:
         action="store_true",
         help="also reconcile the libtpu device-plugin DaemonSet",
     )
+    parser.add_argument(
+        "--manage-agent",
+        action="store_true",
+        help="also reconcile the health-probe-agent DaemonSet "
+        "(DRIVER_REVISION follows the driver's ControllerRevision)",
+    )
     parser.add_argument("--driver-image", default="")
     parser.add_argument("--driver-version", default="latest")
+    parser.add_argument("--probe-interval", type=float, default=30.0)
+    parser.add_argument(
+        "--deep-probe",
+        action="store_true",
+        help="agents also run the ring-attention ICI soak",
+    )
     args = parser.parse_args(argv)
 
     from k8s_operator_libs_tpu.k8s import get_default_client
@@ -219,6 +265,16 @@ def main(argv: Optional[list[str]] = None) -> None:
             version=args.driver_version,
             **({"image": args.driver_image} if args.driver_image else {}),
         )
+    agent_spec = None
+    if args.manage_agent:
+        agent_spec = AgentDaemonSetSpec(
+            namespace=args.namespace,
+            driver_name=args.driver_name,
+            version=args.driver_version,
+            probe_interval_s=args.probe_interval,
+            deep=args.deep_probe,
+            **({"image": args.driver_image} if args.driver_image else {}),
+        )
     controller = UpgradeController(
         get_default_client(),
         ControllerConfig(
@@ -228,6 +284,7 @@ def main(argv: Optional[list[str]] = None) -> None:
             interval_s=args.interval,
             policy=load_policy(args.policy_file),
             daemonset_spec=ds_spec,
+            agent_spec=agent_spec,
             metrics_port=args.metrics_port,
         ),
     )

@@ -15,6 +15,7 @@ reference repo — here it is first-class):
 """
 
 from k8s_operator_libs_tpu.driver.daemonset import (
+    AgentDaemonSetSpec,
     DriverDaemonSetSpec,
     DriverSetReconciler,
     build_daemon_set,
@@ -24,6 +25,7 @@ from k8s_operator_libs_tpu.driver.safe_load_init import (
 )
 
 __all__ = [
+    "AgentDaemonSetSpec",
     "DriverDaemonSetSpec",
     "DriverSetReconciler",
     "announce_and_wait",

@@ -76,6 +76,9 @@ class DriverDaemonSetSpec:
             **self.extra_labels,
         }
 
+    def pod_spec(self) -> dict:
+        return _pod_spec(self)
+
 
 def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
     """Raw podSpec JSON for the driver pod (serialized verbatim by the
@@ -145,7 +148,7 @@ def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
 def template_hash(spec: DriverDaemonSetSpec) -> str:
     """Content hash of everything that defines the pod template."""
     blob = json.dumps(
-        {"pod": _pod_spec(spec), "labels": spec.labels},
+        {"pod": spec.pod_spec(), "labels": spec.labels},
         sort_keys=True,
         separators=(",", ":"),
     )
@@ -164,10 +167,90 @@ def build_daemon_set(spec: DriverDaemonSetSpec) -> DaemonSet:
             selector=LabelSelectorSpec(dict(spec.selector_labels)),
             template=PodTemplateSpec(
                 labels=dict(spec.labels),
-                pod_spec=_pod_spec(spec),
+                pod_spec=spec.pod_spec(),
             ),
         ),
     )
+
+
+@dataclass
+class AgentDaemonSetSpec(DriverDaemonSetSpec):
+    """Desired state of the per-host health-probe-agent DaemonSet.
+
+    One agent pod per TPU host (``health.agent``): it probes the local
+    chips (or the whole torus under ``jax.distributed``) and publishes
+    per-host HealthReport annotations the validation gate aggregates.
+    ``driver_revision`` is stamped into the pod env: when the controller
+    observes a new driver ControllerRevision it re-reconciles this spec,
+    the template hash changes, and the agents restart probing under —
+    and reporting — the new revision (reports pinned to the old revision
+    can never validate the new driver)."""
+
+    name: str = "libtpu-health-agent"
+    probe_interval_s: float = 30.0
+    deep: bool = False
+    driver_revision: str = ""
+
+    @property
+    def selector_labels(self) -> dict[str, str]:
+        return {"app": f"{self.driver_name}-health-agent"}
+
+    def pod_spec(self) -> dict:
+        env = [
+            {"name": k, "value": v} for k, v in sorted(self.env.items())
+        ]
+        env += [
+            {
+                "name": "NODE_NAME",
+                "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
+            },
+            {"name": "DRIVER_REVISION", "value": self.driver_revision},
+            {
+                "name": "HEALTH_PROBE_INTERVAL_S",
+                "value": str(self.probe_interval_s),
+            },
+        ]
+        if self.deep:
+            env.append({"name": "HEALTH_DEEP_PROBE", "value": "1"})
+        pod: dict = {
+            "priorityClassName": "system-node-critical",
+            "hostNetwork": True,
+            "tolerations": [
+                {"key": "google.com/tpu", "operator": "Exists"},
+                # The whole point is probing nodes mid-upgrade: the agent
+                # must keep running on cordoned hosts.
+                {"key": "node.kubernetes.io/unschedulable",
+                 "operator": "Exists", "effect": "NoSchedule"},
+            ],
+            "containers": [
+                {
+                    "name": "health-agent",
+                    "image": f"{self.image}:{self.version}",
+                    "command": [
+                        "python",
+                        "-m",
+                        "k8s_operator_libs_tpu.health.agent",
+                    ],
+                    "env": env,
+                    # Device access for the JAX probe battery.
+                    "securityContext": {"privileged": True},
+                    "volumeMounts": [
+                        {"name": "libtpu-dir",
+                         "mountPath": "/usr/lib/libtpu"},
+                    ],
+                }
+            ],
+            "volumes": [
+                {"name": "libtpu-dir",
+                 "hostPath": {"path": "/usr/lib/libtpu",
+                              "type": "DirectoryOrCreate"}},
+            ],
+        }
+        if self.accelerator:
+            pod["nodeSelector"] = {
+                GKE_TPU_ACCELERATOR_LABEL: self.accelerator
+            }
+        return pod
 
 
 class DriverSetReconciler:

@@ -43,6 +43,7 @@ from k8s_operator_libs_tpu.k8s.objects import (
     Pod,
 )
 from k8s_operator_libs_tpu.k8s.rest import daemon_set_from_json
+from k8s_operator_libs_tpu.k8s.selectors import matches_selector
 
 logger = get_logger(__name__)
 
@@ -252,16 +253,20 @@ class _Handler(BaseHTTPRequestHandler):
         label_selector = query.get("labelSelector", "")
         # /api/v1/nodes[/{name}]
         if parts[:2] == ["api", "v1"] and len(parts) >= 3 and parts[2] == "nodes":
-            if len(parts) == 3 and method == "GET":
-                items = self.store.list_nodes(label_selector=label_selector)
-                return self._send(
-                    200,
-                    {
-                        "apiVersion": "v1",
-                        "kind": "NodeList",
-                        "items": [node_to_json(n) for n in items],
-                    },
-                )
+            if len(parts) == 3:
+                if method == "GET":
+                    items = self.store.list_nodes(
+                        label_selector=label_selector
+                    )
+                    return self._send(
+                        200,
+                        {
+                            "apiVersion": "v1",
+                            "kind": "NodeList",
+                            "items": [node_to_json(n) for n in items],
+                        },
+                    )
+                return self._method_not_allowed(method, parts)
             name = parts[3]
             if method == "GET":
                 return self._send(
@@ -275,8 +280,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._list_pods("", query)
             if len(parts) >= 5 and parts[2] == "namespaces" and parts[4] == "pods":
                 ns = parts[3]
-                if len(parts) == 5 and method == "GET":
-                    return self._list_pods(ns, query)
+                if len(parts) == 5:
+                    if method == "GET":
+                        return self._list_pods(ns, query)
+                    return self._method_not_allowed(method, parts)
                 name = parts[5]
                 if len(parts) == 6 and method == "GET":
                     return self._send(
@@ -320,6 +327,16 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                 )
         raise NotFoundError(f"no route for {method} {'/'.join(parts)}")
+
+    def _method_not_allowed(self, method: str, parts: list[str]) -> None:
+        self._send(
+            405,
+            _status_body(
+                405,
+                "MethodNotAllowed",
+                f"{method} is not supported on /{'/'.join(parts)}",
+            ),
+        )
 
     # -- verb implementations ------------------------------------------------
 
@@ -370,15 +387,15 @@ class _Handler(BaseHTTPRequestHandler):
     ) -> None:
         if not rest_parts:
             if method == "GET":
+                # Full selector semantics (=, !=, in/notin, exists) via
+                # the shared parser — a hand-rolled k=v split would
+                # silently mis-parse negations.
                 selector = query.get("labelSelector", "")
-                match_labels = {}
-                for clause in selector.split(","):
-                    if "=" in clause:
-                        k, _, v = clause.partition("=")
-                        match_labels[k] = v
-                items = self.store.list_daemon_sets(
-                    namespace=ns, match_labels=match_labels or None
-                )
+                items = [
+                    ds
+                    for ds in self.store.list_daemon_sets(namespace=ns)
+                    if matches_selector(ds.metadata.labels, selector)
+                ]
                 return self._send(
                     200,
                     {

@@ -1,0 +1,169 @@
+"""Multi-slice (DCN) coordination end-to-end — BASELINE config 5's shape:
+slices in one DCN group back a single data-parallel JobSet, so the engine
+must never have two of them in flight simultaneously, across a FULL roll
+and in interplay with pipelined validation (SURVEY.md §7 hard part
+'Multi-slice coordination')."""
+
+from __future__ import annotations
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    ProbeResult,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import IN_PROGRESS_STATES
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+def _build_pairs(c: FakeCluster):
+    """Four 2-host slices in two DCN groups: (pool-a0, pool-a1) back
+    JobSet ring-a, (pool-b0, pool-b1) back ring-b."""
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    slices = {}
+    for name, ring in (
+        ("pool-a0", "ring-a"), ("pool-a1", "ring-a"),
+        ("pool-b0", "ring-b"), ("pool-b1", "ring-b"),
+    ):
+        slices[name] = fx.tpu_slice(name, hosts=2, dcn_group=ring)
+        for n in slices[name]:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    return fx, slices
+
+
+def _slice_states(c, slices):
+    return {
+        name: {
+            c.get_node(n.name, cached=False).labels.get(KEYS.state_label, "")
+            for n in nodes
+        }
+        for name, nodes in slices.items()
+    }
+
+
+def _in_flight(states: set[str]) -> bool:
+    return any(
+        s and UpgradeState(s) in IN_PROGRESS_STATES for s in states
+    )
+
+
+def test_full_roll_never_overlaps_a_dcn_pair():
+    """max_parallel=2 gives two slots, but each DCN ring must serialize:
+    at every observation point at most ONE slice per ring is in flight,
+    while slices of DIFFERENT rings do overlap (the slots are used)."""
+    c = FakeCluster()
+    fx, slices = _build_pairs(c)
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString("100%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        dcn_anti_affinity=True,
+    )
+    rings = {
+        "ring-a": ("pool-a0", "pool-a1"),
+        "ring-b": ("pool-b0", "pool-b1"),
+    }
+    cross_ring_overlap = False
+    for tick in range(80):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+        assert mgr.wait_for_async_work()
+        st = _slice_states(c, slices)
+        for ring, (first, second) in rings.items():
+            assert not (_in_flight(st[first]) and _in_flight(st[second])), (
+                f"tick {tick}: both slices of {ring} in flight: {st}"
+            )
+        in_flight_rings = {
+            ring
+            for ring, members in rings.items()
+            if any(_in_flight(st[m]) for m in members)
+        }
+        if len(in_flight_rings) == 2:
+            cross_ring_overlap = True
+        if all(s == {"upgrade-done"} for s in st.values()):
+            break
+    else:
+        raise AssertionError(f"roll did not converge: {_slice_states(c, slices)}")
+    # The anti-affinity must not have degraded to full serialization:
+    # different rings really ran concurrently.
+    assert cross_ring_overlap, "slots unused: rings never overlapped"
+
+
+class GateAfterNProbes:
+    """Rejects each group's first N probes, then passes (a health gate
+    that takes a few reconcile passes, like waiting for fresh reports)."""
+
+    def __init__(self, n: int = 3) -> None:
+        self.n = n
+        self.calls: dict[str, int] = {}
+
+    def probe(self, group) -> ProbeResult:
+        seen = self.calls.get(group.id, 0) + 1
+        self.calls[group.id] = seen
+        if seen <= self.n:
+            return ProbeResult(False, f"reports pending ({seen}/{self.n})")
+        return ProbeResult(True, "healthy")
+
+
+def test_pipelined_validation_still_blocks_dcn_partner():
+    """Pipelined validation readmits the workload and releases the slot,
+    but a slice still VALIDATING counts as in flight for its DCN ring —
+    its partner must not start until the gate passes (the gate may yet
+    re-cordon the slice, and two disrupted slices would stall the
+    JobSet)."""
+    c = FakeCluster()
+    fx, slices = _build_pairs(c)
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(GateAfterNProbes(4))
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        health_gate=SliceHealthGateSpec(enable=True, timeout_second=60),
+        pipeline_validation=True,
+        dcn_anti_affinity=True,
+    )
+    saw_partner_held_during_validation = False
+    for tick in range(120):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS, policy), policy)
+        assert mgr.wait_for_async_work()
+        st = _slice_states(c, slices)
+        for first, second in (
+            ("pool-a0", "pool-a1"), ("pool-b0", "pool-b1"),
+        ):
+            for validating, partner in ((first, second), (second, first)):
+                if st[validating] == {
+                    UpgradeState.VALIDATION_REQUIRED.value
+                }:
+                    # Optimistic uncordon already readmitted the workload…
+                    assert not any(
+                        c.get_node(n.name, cached=False).spec.unschedulable
+                        for n in slices[validating]
+                    )
+                    # …but the DCN partner must still be held back.
+                    assert not _in_flight(st[partner]), (
+                        f"tick {tick}: {partner} started while {validating} "
+                        f"still validating: {st}"
+                    )
+                    saw_partner_held_during_validation = True
+        if all(s == {"upgrade-done"} for s in st.values()):
+            break
+    else:
+        raise AssertionError(f"roll did not converge: {_slice_states(c, slices)}")
+    assert saw_partner_held_during_validation  # the scenario really occurred

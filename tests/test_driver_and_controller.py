@@ -66,6 +66,99 @@ def test_template_hash_tracks_content():
     assert "initContainers" not in build_daemon_set(no_init).spec.template.pod_spec
 
 
+def test_agent_daemon_set_shape():
+    from k8s_operator_libs_tpu.driver import AgentDaemonSetSpec
+
+    spec = AgentDaemonSetSpec(
+        version="1.0", driver_revision="rev-7", probe_interval_s=15.0,
+        deep=True,
+    )
+    ds = build_daemon_set(spec)
+    pod = ds.spec.template.pod_spec
+    container = pod["containers"][0]
+    assert container["command"][-1] == "k8s_operator_libs_tpu.health.agent"
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["DRIVER_REVISION"] == "rev-7"
+    assert env["HEALTH_PROBE_INTERVAL_S"] == "15.0"
+    assert env["HEALTH_DEEP_PROBE"] == "1"
+    # Must keep probing cordoned hosts mid-upgrade.
+    assert any(
+        t["key"] == "node.kubernetes.io/unschedulable"
+        for t in pod["tolerations"]
+    )
+    # Distinct selector from the driver DS.
+    assert ds.spec.selector.match_labels == {"app": "libtpu-health-agent"}
+    # Revision is part of the template hash: a new driver revision is a
+    # template change (agents restart and re-report).
+    spec.driver_revision = "rev-8"
+    assert (
+        template_hash(spec)
+        != ds.metadata.annotations[TEMPLATE_HASH_ANNOTATION]
+    )
+
+
+def test_controller_keeps_agent_revision_pinned():
+    """The controller re-reconciles the agent DaemonSet with the driver's
+    CURRENT ControllerRevision: bumping the driver template updates the
+    agents' DRIVER_REVISION env."""
+    from k8s_operator_libs_tpu.driver import AgentDaemonSetSpec
+
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    node = fx.tpu_node("pool-a", 0)
+    fx.driver_pod(node, ds, hash_suffix="v1")
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        policy=TPUUpgradePolicySpec(auto_upgrade=False),
+        agent_spec=AgentDaemonSetSpec(namespace=NAMESPACE),
+        hbm_floor_fraction=0.0,
+    )
+    controller = UpgradeController(cluster, config)
+    controller.reconcile_once()
+
+    def agent_revision() -> str:
+        live = cluster.get_daemon_set(NAMESPACE, "libtpu-health-agent")
+        env = {
+            e["name"]: e.get("value")
+            for e in live.spec.template.pod_spec["containers"][0]["env"]
+        }
+        return env["DRIVER_REVISION"]
+
+    assert agent_revision() == "v1"
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    controller.reconcile_once()
+    assert agent_revision() == "v2"
+
+
+def test_controller_agent_survives_driver_without_revision():
+    """A just-created driver DS has no ControllerRevision yet: the agent
+    reconcile must proceed with an empty revision, not abort the pass."""
+    from k8s_operator_libs_tpu.driver import AgentDaemonSetSpec
+
+    cluster = FakeCluster()
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        policy=TPUUpgradePolicySpec(auto_upgrade=False),
+        daemonset_spec=DriverDaemonSetSpec(namespace=NAMESPACE),
+        agent_spec=AgentDaemonSetSpec(namespace=NAMESPACE),
+        hbm_floor_fraction=0.0,
+    )
+    controller = UpgradeController(cluster, config)
+    # First pass creates the driver DS; no revision exists (FakeCluster
+    # has no DS controller). Must not raise.
+    controller.reconcile_once()
+    live = cluster.get_daemon_set(NAMESPACE, "libtpu-health-agent")
+    env = {
+        e["name"]: e.get("value")
+        for e in live.spec.template.pod_spec["containers"][0]["env"]
+    }
+    assert env["DRIVER_REVISION"] == ""
+
+
 def test_reconciler_create_unchanged_update():
     cluster = FakeCluster()
     spec = DriverDaemonSetSpec(version="1")

@@ -1,0 +1,214 @@
+"""Concurrency stress tier — the ``go test -race`` analogue (SURVEY.md §5
+'Race detection': the reference relies on safety by construction; CPython
+has no race detector, so this tier hammers every shared structure from
+many threads and asserts invariants that data races would break.  sys
+switch-interval is dropped so the GIL hands over mid-operation as often
+as possible)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.metrics import MetricsRegistry
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.util import (
+    KeyedMutex,
+    StringSet,
+    WorkerTracker,
+    run_batch,
+)
+from tests.fixtures import ClusterFixture
+
+KEYS = UpgradeKeys()
+THREADS = 16
+OPS = 300
+
+
+@pytest.fixture(autouse=True)
+def aggressive_gil_switching():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    yield
+    sys.setswitchinterval(old)
+
+
+def _hammer(fn, threads: int = THREADS):
+    errors: list[BaseException] = []
+
+    def wrapped(i):
+        try:
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=wrapped, args=(i,)) for i in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60.0)
+    assert not any(t.is_alive() for t in ts), "stress thread wedged"
+    if errors:
+        raise errors[0]
+
+
+def test_string_set_stress():
+    s = StringSet()
+
+    def worker(i):
+        for k in range(OPS):
+            item = f"{i}-{k % 7}"
+            s.add(item)
+            assert isinstance(s.has(item), bool)
+            s.remove(item)
+        s.add(f"final-{i}")
+
+    _hammer(worker)
+    assert len(s) == THREADS  # exactly the final adds survive
+
+
+def test_keyed_mutex_exclusion_per_key():
+    mutex = KeyedMutex()
+    counters = {f"k{i}": 0 for i in range(4)}
+
+    def worker(i):
+        key = f"k{i % 4}"
+        for _ in range(OPS):
+            with mutex.lock(key):
+                # Non-atomic read-modify-write: only mutual exclusion
+                # keeps this exact.
+                value = counters[key]
+                time.sleep(0)  # force a potential context switch
+                counters[key] = value + 1
+
+    _hammer(worker)
+    per_key = THREADS // 4 * OPS
+    assert all(v == per_key for v in counters.values()), counters
+
+
+def test_keyed_mutex_same_lock_for_same_key():
+    mutex = KeyedMutex()
+    locks = set()
+
+    def worker(i):
+        for _ in range(OPS):
+            locks.add(id(mutex.lock("the-key")))
+
+    _hammer(worker)
+    assert len(locks) == 1  # racing lock() calls never mint duplicates
+
+
+def test_run_batch_raises_first_error_and_completes_rest():
+    done = StringSet()
+
+    def ok(name):
+        def f():
+            done.add(name)
+        return f
+
+    def bad():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        run_batch([ok("a"), bad, ok("b"), ok("c")])
+    # Everything was attempted even though one member failed (a partially
+    # failed slice batch is maximally advanced).
+    assert len(done) == 3
+
+
+def test_worker_tracker_stress():
+    tracker = WorkerTracker()
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    def job():
+        with lock:
+            counter["n"] += 1
+
+    def spawner(i):
+        for k in range(20):
+            tracker.spawn(job, name=f"w{i}-{k}")
+
+    _hammer(spawner, threads=8)
+    assert tracker.wait_idle(30.0)
+    assert counter["n"] == 8 * 20
+    # A wedged worker is reported, not hidden.
+    release = threading.Event()
+    tracker.spawn(release.wait, name="wedged")
+    assert tracker.wait_idle(0.05) is False
+    release.set()
+    assert tracker.wait_idle(5.0)
+
+
+def test_fake_cluster_patches_race_free():
+    """Concurrent label/annotation merge-patches on one node must not
+    lose writes (the store copies + swaps under its lock)."""
+    cluster = FakeCluster()
+    ClusterFixture(cluster, KEYS).node("n1")
+
+    def worker(i):
+        for k in range(OPS // 3):
+            cluster.patch_node_labels("n1", {f"l-{i}-{k}": "v"})
+            cluster.patch_node_annotations("n1", {f"a-{i}-{k}": "v"})
+
+    _hammer(worker)
+    node = cluster.get_node("n1", cached=False)
+    want = THREADS * (OPS // 3)
+    labels = [k for k in node.labels if k.startswith("l-")]
+    annotations = [k for k in node.annotations if k.startswith("a-")]
+    assert len(labels) == want, f"lost label writes: {len(labels)}/{want}"
+    assert len(annotations) == want
+
+
+def test_node_state_provider_concurrent_group_writes():
+    """Batched group state flips from many threads: per-key mutex +
+    write-then-poll must leave every node at a coherent final state."""
+    cluster = FakeCluster(cache_lag_s=0.01)
+    fx = ClusterFixture(cluster, KEYS)
+    nodes = [fx.node(f"n{i}") for i in range(8)]
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=5.0
+    )
+    states = [
+        UpgradeState.UPGRADE_REQUIRED,
+        UpgradeState.CORDON_REQUIRED,
+        UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+        UpgradeState.DONE,
+    ]
+
+    def worker(i):
+        fresh = [cluster.get_node(n.name, cached=False) for n in nodes]
+        provider.change_nodes_upgrade_state(fresh, states[i % len(states)])
+
+    _hammer(worker, threads=8)
+    final = {
+        cluster.get_node(n.name, cached=False).labels.get(KEYS.state_label)
+        for n in nodes
+    }
+    # Writers raced, but every node holds SOME writer's state (no torn or
+    # empty labels), and reads-after-write converged for each writer.
+    assert final <= {s.value for s in states}
+    assert None not in final
+
+
+def test_metrics_registry_concurrent_updates():
+    registry = MetricsRegistry()
+    registry.describe("ops_total", "ops")
+
+    def worker(i):
+        for _ in range(OPS):
+            registry.inc("ops_total")
+        registry.render()
+
+    _hammer(worker)
+    assert f"ops_total {THREADS * OPS}" in registry.render()

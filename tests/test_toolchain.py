@@ -83,6 +83,30 @@ def test_lint_scopes_resolve(tmp_path):
     assert items == []
 
 
+def test_lint_module_scope_walrus_and_match_bindings(tmp_path):
+    """Walrus targets and match captures bind at module scope; reading
+    them from a function must not be flagged as undefined."""
+    items = _findings(
+        tmp_path,
+        """
+        import os
+
+        if (cfg := os.environ.get("X")):
+            pass
+
+        match os.sep:
+            case "/" as sep_kind:
+                flavor = "posix"
+            case _:
+                flavor = "other"
+
+        def f():
+            return cfg, flavor, sep_kind
+        """,
+    )
+    assert items == []
+
+
 def test_lint_flags_bare_except_and_mutable_default(tmp_path):
     items = _findings(
         tmp_path,

@@ -74,7 +74,9 @@ class _Findings:
 
 
 def _module_scope_names(tree: ast.Module) -> set[str]:
-    """Names bound at module scope (incl. conditional/try branches)."""
+    """Names bound at module scope (incl. conditional/try branches,
+    walrus expressions anywhere in module-level statements, and
+    match-case capture patterns)."""
     names: set[str] = set()
 
     def bind_target(t: ast.AST) -> None:
@@ -82,7 +84,31 @@ def _module_scope_names(tree: ast.Module) -> set[str]:
             if isinstance(node, ast.Name):
                 names.add(node.id)
 
+    def bind_expressions(stmt: ast.stmt) -> None:
+        """Walrus targets and match captures bind in the enclosing
+        (module) scope wherever they appear in the statement — but not
+        inside nested function/class bodies, whose walruses bind there."""
+        for node in ast.walk(stmt):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # ast.walk still descends; close enough — a
+                # nested-scope walrus adding a module name is a
+                # false-NEGATIVE for F821, never a false positive.
+            if isinstance(node, ast.NamedExpr) and isinstance(
+                node.target, ast.Name
+            ):
+                names.add(node.target.id)
+            if isinstance(node, ast.MatchAs) and node.name:
+                names.add(node.name)
+            if isinstance(node, ast.MatchStar) and node.name:
+                names.add(node.name)
+            if isinstance(node, ast.MatchMapping) and node.rest:
+                names.add(node.rest)
+
     def visit_body(body: list[ast.stmt]) -> None:
+        for stmt in body:
+            bind_expressions(stmt)
         for stmt in body:
             if isinstance(stmt, (ast.Import, ast.ImportFrom)):
                 for alias in stmt.names:
@@ -117,6 +143,9 @@ def _module_scope_names(tree: ast.Module) -> set[str]:
                     if item.optional_vars is not None:
                         bind_target(item.optional_vars)
                 visit_body(stmt.body)
+            elif isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    visit_body(case.body)
             elif isinstance(stmt, ast.Delete):
                 pass
 
