@@ -344,8 +344,16 @@ class RollHarness:
             time.sleep(0.02)
         wall_s = time.monotonic() - t0
         self._stop.set()
+        # A leaked agent thread would keep hammering the shared chip and
+        # contaminate the retry roll's readings — wait out the longest
+        # battery and refuse to continue if one is wedged.
         for t in self._threads:
-            t.join(15.0)
+            t.join(120.0)
+            if t.is_alive():
+                raise RuntimeError(
+                    f"{t.name or 'harness'} thread did not stop; a retry "
+                    "would measure self-inflicted contention"
+                )
         return {
             "complete": done,
             "wall_s": round(wall_s, 2),
@@ -448,16 +456,35 @@ def main() -> None:
         )
         return result, downtime
 
+    # Each variant gets ONE retry on an incomplete roll: the shared
+    # tunneled chip has noisy windows where under-floor readings can
+    # outlast the validation timeout, which is environment, not engine.
+    # The attempt count is recorded — a retried run is never silent.
+    def run_variant(pipeline: bool, check_attribution: bool):
+        nonlocal attribution
+        result = downtime = None
+        for attempt in range(2):
+            harness = RollHarness(devices, pipeline=pipeline)
+            harness.sweep_agents_once()
+            if check_attribution and attempt == 0:
+                attribution = harness.attribution_check()
+                log(
+                    f"attribution check: ok={attribution['ok']} "
+                    f"({attribution['detail']})"
+                )
+            log(("pipelined" if pipeline else "sequential") + " roll:")
+            result, downtime = roll_with_canary(harness)
+            result["attempts"] = attempt + 1
+            if result["complete"]:
+                break
+            log("roll incomplete; retrying once (environment noise)")
+        return result, downtime
+
+    attribution: dict = {}
     # -- roll 1: sequential (the headline downtime measurement) -------------
-    seq = RollHarness(devices, pipeline=False)
-    seq.sweep_agents_once()
-    attribution = seq.attribution_check()
-    log(
-        f"attribution check: ok={attribution['ok']} "
-        f"({attribution['detail']})"
+    seq_result, downtime_s = run_variant(
+        pipeline=False, check_attribution=True
     )
-    log("sequential roll:")
-    seq_result, downtime_s = roll_with_canary(seq)
     steps = len(canary.step_times)
     perf = canary.perf_summary()
     log(
@@ -466,10 +493,9 @@ def main() -> None:
     )
 
     # -- roll 2: pipelined validation (wall-clock + downtime overlap) --------
-    pipe = RollHarness(devices, pipeline=True)
-    pipe.sweep_agents_once()
-    log("pipelined roll:")
-    pipe_result, pipe_downtime_s = roll_with_canary(pipe)
+    pipe_result, pipe_downtime_s = run_variant(
+        pipeline=True, check_attribution=False
+    )
     log(
         f"pipelined roll: {pipe_result} canary downtime "
         f"{pipe_downtime_s:.3f}s"
@@ -498,6 +524,8 @@ def main() -> None:
         "max_concurrent_unavailable_pipelined": pipe_result[
             "max_concurrent_unavailable"
         ],
+        "attempts_sequential": seq_result["attempts"],
+        "attempts_pipelined": pipe_result["attempts"],
         "reconcile_ticks": seq_result["ticks"],
         "canary_steps": steps,
         "canary_perf": perf,

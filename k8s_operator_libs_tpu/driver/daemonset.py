@@ -79,10 +79,17 @@ class DriverDaemonSetSpec:
     def pod_spec(self) -> dict:
         return _pod_spec(self)
 
+    # DaemonSet rolling semantics: the driver DS is OnDelete — the
+    # upgrade state machine rolls its pods slice-atomically.
+    update_strategy = "OnDelete"
 
-def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
-    """Raw podSpec JSON for the driver pod (serialized verbatim by the
-    REST client)."""
+
+def _base_pod(spec: DriverDaemonSetSpec) -> tuple[dict, list]:
+    """Shared TPU-host pod skeleton + env list: priority, host network,
+    the google.com/tpu taint toleration, survival of the cordon the
+    upgrade itself performs, NODE_NAME downward API, optional
+    accelerator pinning.  Both the driver and agent pods build on this —
+    a taint or env fix must land in exactly one place."""
     env = [{"name": k, "value": v} for k, v in sorted(spec.env.items())]
     env.append(
         {
@@ -90,43 +97,47 @@ def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
             "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
         }
     )
-    node_selector: dict[str, str] = {}
-    if spec.accelerator:
-        node_selector[GKE_TPU_ACCELERATOR_LABEL] = spec.accelerator
     pod: dict = {
         "priorityClassName": "system-node-critical",
         "hostNetwork": True,
         "tolerations": [
-            # TPU nodes carry the google.com/tpu taint; the driver (like
-            # any device plugin) must land there anyway — and must also
-            # survive the cordon its own upgrade performs.
+            # TPU nodes carry the google.com/tpu taint; driver and agent
+            # (like any device plugin) must land there anyway — and must
+            # also survive the cordon their own upgrade performs.
             {"key": "google.com/tpu", "operator": "Exists"},
             {"key": "node.kubernetes.io/unschedulable",
              "operator": "Exists", "effect": "NoSchedule"},
         ],
-        "containers": [
-            {
-                "name": "device-plugin",
-                "image": f"{spec.image}:{spec.version}",
-                "env": env,
-                "securityContext": {"privileged": True},
-                "volumeMounts": [
-                    {"name": "device-plugin-dir",
-                     "mountPath": "/var/lib/kubelet/device-plugins"},
-                    {"name": "libtpu-dir", "mountPath": "/usr/lib/libtpu"},
-                ],
-            }
-        ],
-        "volumes": [
-            {"name": "device-plugin-dir",
-             "hostPath": {"path": "/var/lib/kubelet/device-plugins"}},
-            {"name": "libtpu-dir",
-             "hostPath": {"path": "/usr/lib/libtpu",
-                          "type": "DirectoryOrCreate"}},
-        ],
     }
-    if node_selector:
-        pod["nodeSelector"] = node_selector
+    if spec.accelerator:
+        pod["nodeSelector"] = {GKE_TPU_ACCELERATOR_LABEL: spec.accelerator}
+    return pod, env
+
+
+def _pod_spec(spec: DriverDaemonSetSpec) -> dict:
+    """Raw podSpec JSON for the driver pod (serialized verbatim by the
+    REST client)."""
+    pod, env = _base_pod(spec)
+    pod["containers"] = [
+        {
+            "name": "device-plugin",
+            "image": f"{spec.image}:{spec.version}",
+            "env": env,
+            "securityContext": {"privileged": True},
+            "volumeMounts": [
+                {"name": "device-plugin-dir",
+                 "mountPath": "/var/lib/kubelet/device-plugins"},
+                {"name": "libtpu-dir", "mountPath": "/usr/lib/libtpu"},
+            ],
+        }
+    ]
+    pod["volumes"] = [
+        {"name": "device-plugin-dir",
+         "hostPath": {"path": "/var/lib/kubelet/device-plugins"}},
+        {"name": "libtpu-dir",
+         "hostPath": {"path": "/usr/lib/libtpu",
+                      "type": "DirectoryOrCreate"}},
+    ]
     if spec.safe_load:
         pod["initContainers"] = [
             {
@@ -169,6 +180,7 @@ def build_daemon_set(spec: DriverDaemonSetSpec) -> DaemonSet:
                 labels=dict(spec.labels),
                 pod_spec=spec.pod_spec(),
             ),
+            update_strategy=spec.update_strategy,
         ),
     )
 
@@ -191,19 +203,19 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
     deep: bool = False
     driver_revision: str = ""
 
+    # RollingUpdate is the point: a template change (new DRIVER_REVISION)
+    # must restart the agent pods, or they would keep publishing reports
+    # pinned to the old revision and the gate could never pass.  Agent
+    # restarts don't touch the torus — only the driver DS is OnDelete.
+    update_strategy = "RollingUpdate"
+
     @property
     def selector_labels(self) -> dict[str, str]:
         return {"app": f"{self.driver_name}-health-agent"}
 
     def pod_spec(self) -> dict:
-        env = [
-            {"name": k, "value": v} for k, v in sorted(self.env.items())
-        ]
+        pod, env = _base_pod(self)
         env += [
-            {
-                "name": "NODE_NAME",
-                "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
-            },
             {"name": "DRIVER_REVISION", "value": self.driver_revision},
             {
                 "name": "HEALTH_PROBE_INTERVAL_S",
@@ -212,44 +224,29 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
         ]
         if self.deep:
             env.append({"name": "HEALTH_DEEP_PROBE", "value": "1"})
-        pod: dict = {
-            "priorityClassName": "system-node-critical",
-            "hostNetwork": True,
-            "tolerations": [
-                {"key": "google.com/tpu", "operator": "Exists"},
-                # The whole point is probing nodes mid-upgrade: the agent
-                # must keep running on cordoned hosts.
-                {"key": "node.kubernetes.io/unschedulable",
-                 "operator": "Exists", "effect": "NoSchedule"},
-            ],
-            "containers": [
-                {
-                    "name": "health-agent",
-                    "image": f"{self.image}:{self.version}",
-                    "command": [
-                        "python",
-                        "-m",
-                        "k8s_operator_libs_tpu.health.agent",
-                    ],
-                    "env": env,
-                    # Device access for the JAX probe battery.
-                    "securityContext": {"privileged": True},
-                    "volumeMounts": [
-                        {"name": "libtpu-dir",
-                         "mountPath": "/usr/lib/libtpu"},
-                    ],
-                }
-            ],
-            "volumes": [
-                {"name": "libtpu-dir",
-                 "hostPath": {"path": "/usr/lib/libtpu",
-                              "type": "DirectoryOrCreate"}},
-            ],
-        }
-        if self.accelerator:
-            pod["nodeSelector"] = {
-                GKE_TPU_ACCELERATOR_LABEL: self.accelerator
+        pod["containers"] = [
+            {
+                "name": "health-agent",
+                "image": f"{self.image}:{self.version}",
+                "command": [
+                    "python",
+                    "-m",
+                    "k8s_operator_libs_tpu.health.agent",
+                ],
+                "env": env,
+                # Device access for the JAX probe battery.
+                "securityContext": {"privileged": True},
+                "volumeMounts": [
+                    {"name": "libtpu-dir",
+                     "mountPath": "/usr/lib/libtpu"},
+                ],
             }
+        ]
+        pod["volumes"] = [
+            {"name": "libtpu-dir",
+             "hostPath": {"path": "/usr/lib/libtpu",
+                          "type": "DirectoryOrCreate"}},
+        ]
         return pod
 
 

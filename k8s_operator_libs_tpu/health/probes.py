@@ -439,7 +439,7 @@ def hbm_bandwidth_probe(
     from k8s_operator_libs_tpu.hw import chip_spec as _chip_spec
 
     spec = _chip_spec(device.device_kind)
-    if spec is not None and gbps > 1.15 * spec.hbm_gbps:
+    if spec is not None and gbps > 1.05 * spec.hbm_gbps:
         # Over physical bandwidth: fiction, not a measurement (same
         # rationale as the matmul probe's >100 % MFU clamp).
         return CheckResult(

@@ -148,7 +148,7 @@ def daemon_set_to_json_full(ds: DaemonSet) -> dict:
         "metadata": _meta_to_json(ds.metadata),
         "spec": {
             "selector": {"matchLabels": dict(ds.spec.selector.match_labels)},
-            "updateStrategy": {"type": "OnDelete"},
+            "updateStrategy": {"type": ds.spec.update_strategy},
             "template": {
                 "metadata": {
                     "labels": dict(ds.spec.template.labels),

@@ -183,6 +183,11 @@ class PodTemplateSpec:
 class DaemonSetSpec:
     selector: LabelSelectorSpec = field(default_factory=LabelSelectorSpec)
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # "OnDelete" (driver DS: the upgrade state machine rolls pods
+    # slice-atomically, the DS controller must never split a torus) or
+    # "RollingUpdate" (agent DS: pods must restart on template change so
+    # DRIVER_REVISION re-pins).
+    update_strategy: str = "OnDelete"
 
 
 @dataclass

@@ -301,6 +301,9 @@ def daemon_set_from_json(d: dict) -> DaemonSet:
                 annotations=dict(template_meta.get("annotations") or {}),
                 pod_spec=dict(template.get("spec") or {}),
             ),
+            update_strategy=(
+                (spec.get("updateStrategy") or {}).get("type", "OnDelete")
+            ),
         ),
         status=DaemonSetStatus(
             desired_number_scheduled=int(
@@ -322,10 +325,11 @@ def daemon_set_to_json(ds: DaemonSet) -> dict:
         },
         "spec": {
             "selector": {"matchLabels": dict(ds.spec.selector.match_labels)},
-            # OnDelete: the upgrade state machine controls pod restarts
-            # (reference model — the DS controller must not roll pods
-            # behind the engine's back).
-            "updateStrategy": {"type": "OnDelete"},
+            # Driver DSs are OnDelete (the upgrade state machine controls
+            # pod restarts; the DS controller must not roll pods behind
+            # the engine's back); agent DSs are RollingUpdate (pods must
+            # restart when DRIVER_REVISION re-pins).
+            "updateStrategy": {"type": ds.spec.update_strategy},
             "template": {
                 "metadata": {
                     "labels": dict(ds.spec.template.labels),

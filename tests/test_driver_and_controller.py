@@ -97,6 +97,35 @@ def test_agent_daemon_set_shape():
     )
 
 
+def test_update_strategy_split_survives_the_wire():
+    """Driver DS is OnDelete (the engine rolls pods slice-atomically);
+    agent DS is RollingUpdate (a DRIVER_REVISION template change must
+    restart agents or their reports stay pinned to the old revision and
+    the gate never passes).  Both must survive JSON round-trips."""
+    from k8s_operator_libs_tpu.driver import AgentDaemonSetSpec
+    from k8s_operator_libs_tpu.k8s.rest import (
+        daemon_set_from_json,
+        daemon_set_to_json,
+    )
+
+    driver_ds = build_daemon_set(DriverDaemonSetSpec())
+    agent_ds = build_daemon_set(AgentDaemonSetSpec())
+    assert driver_ds.spec.update_strategy == "OnDelete"
+    assert agent_ds.spec.update_strategy == "RollingUpdate"
+    assert (
+        daemon_set_to_json(agent_ds)["spec"]["updateStrategy"]["type"]
+        == "RollingUpdate"
+    )
+    round_tripped = daemon_set_from_json(daemon_set_to_json(agent_ds))
+    assert round_tripped.spec.update_strategy == "RollingUpdate"
+    assert (
+        daemon_set_from_json(
+            daemon_set_to_json(driver_ds)
+        ).spec.update_strategy
+        == "OnDelete"
+    )
+
+
 def test_controller_keeps_agent_revision_pinned():
     """The controller re-reconciles the agent DaemonSet with the driver's
     CURRENT ControllerRevision: bumping the driver template updates the

@@ -108,8 +108,6 @@ def _module_scope_names(tree: ast.Module) -> set[str]:
 
     def visit_body(body: list[ast.stmt]) -> None:
         for stmt in body:
-            bind_expressions(stmt)
-        for stmt in body:
             if isinstance(stmt, (ast.Import, ast.ImportFrom)):
                 for alias in stmt.names:
                     if alias.name == "*":
@@ -149,6 +147,11 @@ def _module_scope_names(tree: ast.Module) -> set[str]:
             elif isinstance(stmt, ast.Delete):
                 pass
 
+    # One expression-binding pass over the top-level statements covers
+    # every nested body (ast.walk is recursive); visit_body recursion
+    # must not repeat it per nesting level.
+    for stmt in tree.body:
+        bind_expressions(stmt)
     visit_body(tree.body)
     return names
 
