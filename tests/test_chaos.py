@@ -9,12 +9,18 @@ slow the upgrade but never wedge or corrupt it."""
 
 from __future__ import annotations
 
+import contextlib
 import random
 
 import pytest
 
 from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
-from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    KubeApiServer,
+    KubeConfig,
+    RestClient,
+)
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
     UpgradeKeys,
@@ -98,45 +104,50 @@ def test_converges_through_flaky_apiserver():
         assert live.labels[keys.state_label] == "upgrade-done"
 
 
-def test_converges_across_controller_restarts():
+@pytest.mark.parametrize("tier", ["fake", "rest"])
+def test_converges_across_controller_restarts(tier):
     """A fresh manager every tick == controller crash after every pass;
-    all progress must come from cluster state alone."""
-    cluster = FakeCluster()
+    all progress must come from cluster state alone.  The "rest" tier
+    runs the same chaos with every engine call ALSO crossing the HTTP
+    wire, with a fresh RestClient per 'restart' (like a restarted
+    controller pod re-establishing its connection pool)."""
+    store = FakeCluster()
     keys = UpgradeKeys()
-    nodes = _upgrade_scenario(cluster, keys)
+    nodes = _upgrade_scenario(store, keys)
     policy = TPUUpgradePolicySpec(
         auto_upgrade=True,
         drain_spec=DrainSpec(enable=True, timeout_second=5),
     )
+    server_cm = (
+        KubeApiServer(store) if tier == "rest" else contextlib.nullcontext()
+    )
+    with server_cm as server:
 
-    managers = []
+        def fresh_client():
+            if tier == "rest":
+                return RestClient(KubeConfig(host=server.host), timeout_s=10.0)
+            return store
 
-    def fresh_every_time():
-        m = ClusterUpgradeStateManager(
-            cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=1.0
-        )
-        managers.append(m)
-        return m
-
-    # run_until_done creates ONE manager; emulate restarts by looping
-    # manually with a new manager per tick instead.
-    for tick in range(200):
-        mgr = fresh_every_time()
-        try:
-            state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
-            mgr.apply_state(state, policy)
-        finally:
-            mgr.wait_for_async_work(10.0)
-        states = {
-            n.name: cluster.get_node(n.name, cached=False).labels.get(
-                keys.state_label, ""
+        for tick in range(200):
+            client = fresh_client()
+            mgr = ClusterUpgradeStateManager(
+                client, keys=keys, poll_interval_s=0.005, poll_timeout_s=1.0
             )
-            for n in nodes
-        }
-        if all(s == "upgrade-done" for s in states.values()):
-            break
-    else:
-        pytest.fail(f"never converged: {states}")
+            try:
+                state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+                mgr.apply_state(state, policy)
+            finally:
+                mgr.wait_for_async_work(10.0)
+            states = {
+                n.name: client.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for n in nodes
+            }
+            if all(s == "upgrade-done" for s in states.values()):
+                break
+        else:
+            pytest.fail(f"never converged ({tier}): {states}")
 
 
 def test_partial_label_write_resolves_forward():
