@@ -4,9 +4,19 @@
 
 PYTHON ?= python
 
-.PHONY: all test test-fast lint cov-report bench graft-check clean
+.PHONY: all test test-fast lint cov-report bench graft-check clean \
+	generate generate-check
 
 all: lint test
+
+# Regenerate the TPUUpgradePolicy CRD from api/v1alpha1 (controller-gen
+# analogue; reference Makefile:60-66 `make generate`).
+generate:
+	$(PYTHON) tools/gen_crd.py
+
+# Fail on generated-file drift (reference ci.yaml go-check job).
+generate-check:
+	$(PYTHON) tools/gen_crd.py --check
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -10,3 +10,8 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
     TPUUpgradePolicySpec,
     WaitForCompletionSpec,
 )
+from k8s_operator_libs_tpu.api.schema import (  # noqa: F401
+    crd_manifest,
+    spec_schema,
+    validate_object,
+)

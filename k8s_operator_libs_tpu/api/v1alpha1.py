@@ -117,6 +117,10 @@ class _SpecBase:
             f = by_json_name.get(key)
             if f is None:
                 continue  # tolerate unknown fields like the apiserver does
+            if raw is None:
+                # Explicit null = unset: a structural-schema apiserver
+                # prunes nulls and applies the field default.
+                continue
             typ = _NESTED_TYPES.get((cls.__name__, f.name))
             if typ is not None and raw is not None:
                 kwargs[f.name] = typ.from_dict(raw)
@@ -289,6 +293,8 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
       (multi-slice data-parallel) group down simultaneously.
     """
 
+    UNAVAILABILITY_UNITS = ("slice", "node")
+
     slice_atomic: bool = True
     # "slice" or "node".
     unavailability_unit: str = "slice"
@@ -318,7 +324,7 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
         super().validate()
         if self.stuck_threshold_second < 0:
             raise ValidationError("stuckThresholdSeconds must be >= 0")
-        if self.unavailability_unit not in ("slice", "node"):
+        if self.unavailability_unit not in self.UNAVAILABILITY_UNITS:
             raise ValidationError(
                 "unavailabilityUnit must be 'slice' or 'node', got "
                 f"{self.unavailability_unit!r}"

@@ -217,9 +217,21 @@ def load_policy(path: Optional[str]) -> DriverUpgradePolicySpec:
         return TPUUpgradePolicySpec(auto_upgrade=True)
     import yaml
 
+    from k8s_operator_libs_tpu.api.schema import spec_schema, validate_object
+
     with open(path) as f:
         data = yaml.safe_load(f) or {}
-    return TPUUpgradePolicySpec.from_dict(data)
+    # Reject malformed policy with apiserver-style messages — the same
+    # schema the generated CRD advertises (config/crd/), so a file that
+    # loads here would also be admitted as a TPUUpgradePolicy CR.
+    errors = validate_object(data, spec_schema(TPUUpgradePolicySpec))
+    if errors:
+        raise ValueError(
+            f"invalid policy {path}: " + "; ".join(errors)
+        )
+    policy = TPUUpgradePolicySpec.from_dict(data)
+    policy.validate()
+    return policy
 
 
 def main(argv: Optional[list[str]] = None) -> None:
