@@ -171,11 +171,17 @@ def spec_schema(cls: type = TPUUpgradePolicySpec) -> dict[str, Any]:
     return schema
 
 
+POLICY_GROUP = "upgrade.tpu.google.com"
+POLICY_VERSION = "v1alpha1"
+POLICY_PLURAL = "tpuupgradepolicies"
+POLICY_KIND = "TPUUpgradePolicy"
+
+
 def crd_manifest(
-    group: str = "upgrade.tpu.google.com",
-    kind: str = "TPUUpgradePolicy",
-    plural: str = "tpuupgradepolicies",
-    version: str = "v1alpha1",
+    group: str = POLICY_GROUP,
+    kind: str = POLICY_KIND,
+    plural: str = POLICY_PLURAL,
+    version: str = POLICY_VERSION,
     spec_cls: type = TPUUpgradePolicySpec,
 ) -> dict[str, Any]:
     """Full CustomResourceDefinition manifest embedding the policy schema."""
@@ -217,6 +223,21 @@ def crd_manifest(
             ],
         },
     }
+
+
+def register_policy_crd(cluster) -> None:
+    """Install the TPUUpgradePolicy CRD on a cluster/store (the runtime
+    analogue of ``kubectl apply -f config/crd/``): enables the CR routes
+    and wires the generated schema in as the admission validator, so an
+    invalid CR is rejected 422 with field paths."""
+    schema = spec_schema(TPUUpgradePolicySpec)
+
+    def _validate(obj: dict) -> list[str]:
+        return validate_object(obj.get("spec") or {}, schema)
+
+    cluster.register_custom_resource(
+        POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, validator=_validate
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -77,6 +77,12 @@ class ControllerConfig:
     # only for environments whose probe hosts are not the accelerator the
     # slice labels claim (CPU test rigs).
     hbm_floor_fraction: float = 0.5
+    # (namespace, name) of a TPUUpgradePolicy CR to read the policy from
+    # each pass instead of a static ``policy`` — the consumer-operator
+    # pattern (reference SURVEY §1: "policy flows in from the consumer's
+    # CRD").  The controller also writes upgrade counters back to the
+    # CR's status subresource.
+    policy_ref: Optional[tuple[str, str]] = None
 
 
 class UpgradeController:
@@ -121,11 +127,17 @@ class UpgradeController:
         # Stuck-state dwell gauge flows into the same registry.
         self.manager.stuck_detector.registry = self.registry
         self._stop = False
+        # Policy-CR bookkeeping: the CR fetched this pass (reused for the
+        # status write) and whether "missing" was already logged.
+        self._policy_cr: Optional[dict] = None
+        self._policy_cr_missing = False
 
     def reconcile_once(self) -> bool:
         """One full pass; returns False when the snapshot was incoherent
         (requeue and retry, reference reconcile-error semantics)."""
         t0 = time.monotonic()
+        if self.config.policy_ref is not None:
+            self._refresh_policy_from_cr()
         if self.ds_reconciler is not None:
             self.ds_reconciler.reconcile()
         if self.agent_reconciler is not None:
@@ -143,6 +155,8 @@ class UpgradeController:
             logger.warning("build_state: %s (requeueing)", e)
             return False
         self.manager.apply_state(state, self.config.policy)
+        if self.config.policy_ref is not None:
+            self._update_cr_status(state)
         duration = time.monotonic() - t0
         self.metrics.observe(self.manager, state, duration)
         self.slice_timer.observe_state(state)
@@ -155,6 +169,96 @@ class UpgradeController:
                 ev.message,
             )
         return True
+
+    def _refresh_policy_from_cr(self) -> None:
+        """Re-read the TPUUpgradePolicy CR: a policy edit takes effect on
+        the next pass, like a consumer operator re-reading its CRD spec
+        every reconcile.  A missing CR disables upgrades (policy None =
+        no-op gate, reference upgrade_state.go:372); a malformed one
+        keeps the last good policy (admission should have rejected it)."""
+        from k8s_operator_libs_tpu.api.schema import (
+            POLICY_GROUP,
+            POLICY_PLURAL,
+            POLICY_VERSION,
+        )
+        from k8s_operator_libs_tpu.k8s.client import NotFoundError
+
+        ns, name = self.config.policy_ref
+        try:
+            cr = self.client.get_custom_object(
+                POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, name
+            )
+        except NotFoundError:
+            # Log on every transition into "missing" AND on the very
+            # first pass: a typoed --policy-cr must not be a silent
+            # permanent no-op.
+            if not self._policy_cr_missing:
+                logger.warning(
+                    "policy CR %s/%s not found: upgrades paused "
+                    "(create the TPUUpgradePolicy or fix --policy-cr)",
+                    ns,
+                    name,
+                )
+            self._policy_cr_missing = True
+            self._policy_cr = None
+            self.config.policy = None
+            return
+        self._policy_cr_missing = False
+        self._policy_cr = cr
+        try:
+            policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
+            policy.validate()
+            self.config.policy = policy
+        except (ValueError, TypeError) as e:
+            logger.warning(
+                "policy CR %s/%s invalid (%s): keeping previous policy",
+                ns,
+                name,
+                e,
+            )
+
+    def _update_cr_status(self, state) -> None:
+        """Publish the method-counters (reference upgrade_state.go:
+        1038-1120 exposes them for consumers to export) to the CR's
+        status subresource, so `kubectl get tpuupgradepolicy -o yaml`
+        shows progress.  Uses the CR fetched by _refresh_policy_from_cr
+        this pass; lost-update conflicts are skipped — the next pass
+        rewrites."""
+        from k8s_operator_libs_tpu.api.schema import (
+            POLICY_GROUP,
+            POLICY_PLURAL,
+            POLICY_VERSION,
+        )
+        from k8s_operator_libs_tpu.k8s.client import (
+            ConflictError,
+            NotFoundError,
+        )
+
+        ns, name = self.config.policy_ref
+        cr = self._policy_cr
+        if cr is None:
+            return
+        m = self.manager
+        try:
+            status = {
+                "totalManagedNodes": m.get_total_managed_nodes(state),
+                "totalManagedGroups": m.get_total_managed_groups(state),
+                "upgradesInProgress": m.get_upgrades_in_progress(state),
+                "upgradesDone": m.get_upgrades_done(state),
+                "upgradesFailed": m.get_upgrades_failed(state),
+                "upgradesPending": m.get_upgrades_pending(state),
+                "currentUnavailableNodes": m.get_current_unavailable_nodes(
+                    state
+                ),
+            }
+            if cr.get("status") == status:
+                return  # no churn: don't bump resourceVersion every pass
+            cr["status"] = status
+            self.client.update_custom_object_status(
+                POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+            )
+        except (NotFoundError, ConflictError) as e:
+            logger.debug("status update skipped: %s", e)
 
     def _current_driver_revision(self) -> str:
         """Current ControllerRevision hash of the (first) driver
@@ -265,7 +369,23 @@ def main(argv: Optional[list[str]] = None) -> None:
         action="store_true",
         help="agents also run the ring-attention ICI soak",
     )
+    parser.add_argument(
+        "--policy-cr",
+        default="",
+        metavar="NAMESPACE/NAME",
+        help="read the policy from a TPUUpgradePolicy CR each pass "
+        "(requires config/crd/ installed) instead of --policy-file; "
+        "upgrade counters are written back to the CR status",
+    )
     args = parser.parse_args(argv)
+    if args.policy_cr and args.policy_file:
+        parser.error("--policy-cr and --policy-file are mutually exclusive")
+    policy_ref = None
+    if args.policy_cr:
+        ns, sep, name = args.policy_cr.partition("/")
+        if not sep or not ns or not name:
+            parser.error("--policy-cr must look like NAMESPACE/NAME")
+        policy_ref = (ns, name)
 
     from k8s_operator_libs_tpu.k8s import get_default_client
 
@@ -294,10 +414,13 @@ def main(argv: Optional[list[str]] = None) -> None:
             driver_labels=_parse_labels(args.selector),
             driver_name=args.driver_name,
             interval_s=args.interval,
-            policy=load_policy(args.policy_file),
+            policy=(
+                None if policy_ref else load_policy(args.policy_file)
+            ),
             daemonset_spec=ds_spec,
             agent_spec=agent_spec,
             metrics_port=args.metrics_port,
+            policy_ref=policy_ref,
         ),
     )
     signal.signal(signal.SIGTERM, controller.stop)

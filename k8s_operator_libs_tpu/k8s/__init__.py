@@ -27,7 +27,11 @@ from k8s_operator_libs_tpu.k8s.objects import (  # noqa: F401
     Pod,
     PodPhase,
 )
-from k8s_operator_libs_tpu.k8s.client import FakeCluster, NotFoundError  # noqa: F401
+from k8s_operator_libs_tpu.k8s.client import (  # noqa: F401
+    FakeCluster,
+    InvalidError,
+    NotFoundError,
+)
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper, DrainError  # noqa: F401
 from k8s_operator_libs_tpu.k8s.rest import (  # noqa: F401
     KubeConfig,

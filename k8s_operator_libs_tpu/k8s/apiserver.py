@@ -34,6 +34,7 @@ from k8s_operator_libs_tpu.k8s.client import (
     ConflictError,
     EvictionBlockedError,
     FakeCluster,
+    InvalidError,
     NotFoundError,
 )
 from k8s_operator_libs_tpu.k8s.objects import (
@@ -230,6 +231,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, _status_body(404, "NotFound", str(e)))
         except ConflictError as e:
             self._send(409, _status_body(409, "AlreadyExists", str(e)))
+        except InvalidError as e:
+            self._send(
+                422,
+                _status_body(
+                    422,
+                    "Invalid",
+                    str(e),
+                    causes=[
+                        {"reason": "FieldValueInvalid", "message": c}
+                        for c in e.causes
+                    ],
+                ),
+            )
         except EvictionBlockedError as e:
             self._send(
                 429,
@@ -326,7 +340,73 @@ class _Handler(BaseHTTPRequestHandler):
                         ],
                     },
                 )
+        # /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+        # — custom resources (CRDs registered on the store).
+        if parts[:1] == ["apis"] and len(parts) >= 6 and parts[3] == "namespaces":
+            group, version, ns = parts[1], parts[2], parts[4]
+            plural = parts[5]
+            name = parts[6] if len(parts) >= 7 else None
+            status_sub = len(parts) == 8 and parts[7] == "status"
+            if len(parts) <= 7 or status_sub:
+                return self._custom_objects(
+                    method, group, version, plural, ns, name, status_sub
+                )
         raise NotFoundError(f"no route for {method} {'/'.join(parts)}")
+
+    def _custom_objects(
+        self,
+        method: str,
+        group: str,
+        version: str,
+        plural: str,
+        ns: str,
+        name: Optional[str],
+        status_sub: bool = False,
+    ) -> None:
+        api_version = f"{group}/{version}"
+        if name is None:
+            if method == "GET":
+                items = self.store.list_custom_objects(
+                    group, version, plural, namespace=ns
+                )
+                return self._send(
+                    200,
+                    {
+                        "apiVersion": api_version,
+                        "kind": "List",
+                        "items": items,
+                    },
+                )
+            if method == "POST":
+                created = self.store.create_custom_object(
+                    group, version, plural, ns, self._read_body()
+                )
+                return self._send(201, created)
+            return self._method_not_allowed(
+                method, ["apis", group, version, "namespaces", ns, plural]
+            )
+        if method == "GET" and not status_sub:
+            return self._send(
+                200,
+                self.store.get_custom_object(group, version, plural, ns, name),
+            )
+        if method == "PUT":
+            body = self._read_body()
+            # The URL owns the identity; a mismatched body name must not
+            # silently retarget another object.
+            body.setdefault("metadata", {})["name"] = name
+            update = (
+                self.store.update_custom_object_status
+                if status_sub
+                else self.store.update_custom_object
+            )
+            return self._send(
+                200, update(group, version, plural, ns, body)
+            )
+        if method == "DELETE" and not status_sub:
+            self.store.delete_custom_object(group, version, plural, ns, name)
+            return self._send(200, _status_body(200, "Success", "deleted"))
+        raise NotFoundError(f"no custom-resource route {method}")
 
     def _method_not_allowed(self, method: str, parts: list[str]) -> None:
         self._send(

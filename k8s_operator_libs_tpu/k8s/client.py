@@ -24,8 +24,10 @@ against envtest.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
+import uuid
 from collections import Counter, defaultdict
 from typing import Callable, Optional
 
@@ -68,6 +70,16 @@ class ThrottledError(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class InvalidError(ValueError):
+    """Object rejected by schema validation (HTTP 422 Invalid) — what a
+    real apiserver returns when a CR violates its CRD's structural
+    schema.  ``causes`` carries per-field error strings."""
+
+    def __init__(self, message: str, causes: Optional[list[str]] = None):
+        super().__init__(message)
+        self.causes = list(causes or [])
 
 
 _HISTORY_CAP = 64
@@ -124,6 +136,12 @@ class FakeCluster:
         # verb -> count; exposed for bench round-trip accounting
         self.stats: Counter = Counter()
         self._pod_deleted_hooks: list[Callable[[Pod], None]] = []
+        # Registered CRDs: (group, version, plural) -> admission validator.
+        self._custom_kinds: dict[
+            tuple[str, str, str], Optional[Callable[[dict], list[str]]]
+        ] = {}
+        # (group, version, plural, namespace, name) -> raw object dict.
+        self._custom: dict[tuple[str, str, str, str, str], dict] = {}
         # (namespace, name) pairs whose eviction a PodDisruptionBudget
         # currently blocks (429 in the real API) — test/bench knob.
         self._eviction_blocked: set[tuple[str, str]] = set()
@@ -397,6 +415,173 @@ class FakeCluster:
                 for r in self._revisions.objs.values()
                 if (not namespace or r.metadata.namespace == namespace)
                 and matches_selector(r.metadata.labels, label_selector)
+            ]
+
+    # -- custom resources ----------------------------------------------------
+    # Generic dict-shaped CR storage, the apiextensions analogue: a CRD
+    # must be registered (like installing config/crd/ on a real cluster)
+    # before its group/plural routes exist; an optional validator models
+    # the structural-schema admission step (422 Invalid).
+
+    def register_custom_resource(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        validator: Optional[Callable[[dict], list[str]]] = None,
+    ) -> None:
+        """Install a CRD: enable CRUD for ``/apis/{group}/{version}/.../
+        {plural}``.  ``validator(obj) -> [errors]`` runs on create/update
+        and rejects with :class:`InvalidError` like apiserver admission."""
+        with self._lock:
+            self._custom_kinds[(group, version, plural)] = validator
+
+    def _custom_kind(self, group: str, version: str, plural: str):
+        key = (group, version, plural)
+        if key not in self._custom_kinds:
+            raise NotFoundError(
+                f"the server could not find the requested resource "
+                f"({plural}.{group}/{version} — CRD not registered)"
+            )
+        return key
+
+    def _admit_custom(self, kind_key, obj: dict) -> None:
+        validator = self._custom_kinds[kind_key]
+        if validator is None:
+            return
+        errors = validator(obj)
+        if errors:
+            name = (obj.get("metadata") or {}).get("name", "")
+            raise InvalidError(
+                f"{kind_key[2]}.{kind_key[0]} {name!r} is invalid: "
+                + "; ".join(errors),
+                causes=errors,
+            )
+
+    def create_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        self._call("create_custom_object")
+        with self._lock:
+            kind_key = self._custom_kind(group, version, plural)
+            name = (obj.get("metadata") or {}).get("name")
+            if not name:
+                raise InvalidError("metadata.name is required")
+            self._admit_custom(kind_key, obj)
+            key = kind_key + (namespace, name)
+            if key in self._custom:
+                raise ConflictError(
+                    f"{plural} {namespace}/{name} already exists"
+                )
+            stored = copy.deepcopy(obj)
+            meta = stored.setdefault("metadata", {})
+            meta["namespace"] = namespace
+            meta["uid"] = f"uid-{uuid.uuid4().hex[:12]}"
+            meta["resourceVersion"] = "1"
+            self._custom[key] = stored
+            return copy.deepcopy(stored)
+
+    def get_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, name: str
+    ) -> dict:
+        self._call("get_custom_object")
+        with self._lock:
+            key = self._custom_kind(group, version, plural) + (namespace, name)
+            obj = self._custom.get(key)
+            if obj is None:
+                raise NotFoundError(f"{plural} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def _replace_custom(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        namespace: str,
+        obj: dict,
+        subresource_status: bool,
+    ) -> dict:
+        kind_key = self._custom_kind(group, version, plural)
+        name = (obj.get("metadata") or {}).get("name")
+        key = kind_key + (namespace, name)
+        current = self._custom.get(key)
+        if current is None:
+            raise NotFoundError(f"{plural} {namespace}/{name} not found")
+        sent_rv = (obj.get("metadata") or {}).get("resourceVersion")
+        cur_rv = current["metadata"]["resourceVersion"]
+        if sent_rv is not None and str(sent_rv) != str(cur_rv):
+            raise ConflictError(
+                f"{plural} {namespace}/{name}: the object has been "
+                f"modified (resourceVersion {sent_rv} != {cur_rv})"
+            )
+        if subresource_status:
+            # The status endpoint replaces ONLY .status; spec edits in
+            # the body are ignored (apiextensions subresource semantics).
+            stored = copy.deepcopy(current)
+            stored["status"] = copy.deepcopy(obj.get("status"))
+        else:
+            self._admit_custom(kind_key, obj)
+            stored = copy.deepcopy(obj)
+            # The main resource ignores .status when the status
+            # subresource is enabled (all CRDs here declare it): writes
+            # to status must go through update_custom_object_status.
+            if "status" in current:
+                stored["status"] = copy.deepcopy(current["status"])
+            else:
+                stored.pop("status", None)
+        meta = stored.setdefault("metadata", {})
+        meta["namespace"] = namespace
+        meta["uid"] = current["metadata"]["uid"]
+        meta["resourceVersion"] = str(int(cur_rv) + 1)
+        self._custom[key] = stored
+        return copy.deepcopy(stored)
+
+    def update_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        """Replace (PUT) with optimistic concurrency: a body carrying a
+        stale resourceVersion conflicts, like a real apiserver update.
+        ``.status`` in the body is stripped — the status subresource owns
+        it."""
+        self._call("update_custom_object")
+        with self._lock:
+            return self._replace_custom(
+                group, version, plural, namespace, obj,
+                subresource_status=False,
+            )
+
+    def update_custom_object_status(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        """PUT to the ``/status`` subresource: replaces only ``.status``."""
+        self._call("update_custom_object_status")
+        with self._lock:
+            return self._replace_custom(
+                group, version, plural, namespace, obj,
+                subresource_status=True,
+            )
+
+    def delete_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, name: str
+    ) -> None:
+        self._call("delete_custom_object")
+        with self._lock:
+            key = self._custom_kind(group, version, plural) + (namespace, name)
+            if key not in self._custom:
+                raise NotFoundError(f"{plural} {namespace}/{name} not found")
+            del self._custom[key]
+
+    def list_custom_objects(
+        self, group: str, version: str, plural: str, namespace: str = ""
+    ) -> list[dict]:
+        self._call("list_custom_objects")
+        with self._lock:
+            kind_key = self._custom_kind(group, version, plural)
+            return [
+                copy.deepcopy(o)
+                for key, o in sorted(self._custom.items())
+                if key[:3] == kind_key
+                and (not namespace or key[3] == namespace)
             ]
 
     # -- fixtures ----------------------------------------------------------

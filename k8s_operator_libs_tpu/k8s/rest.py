@@ -35,6 +35,7 @@ from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import (
     ConflictError,
     EvictionBlockedError,
+    InvalidError,
     NotFoundError,
     ThrottledError,
 )
@@ -544,6 +545,17 @@ class RestClient:
             raise NotFoundError(f"{method} {path}: {detail}")
         if status == 409:
             raise ConflictError(f"{method} {path}: {detail}")
+        if status == 422:
+            causes = []
+            try:
+                body_json = json.loads(payload)
+                causes = [
+                    c.get("message", "")
+                    for c in (body_json.get("details") or {}).get("causes", [])
+                ]
+            except (ValueError, AttributeError):
+                pass
+            raise InvalidError(f"{method} {path}: {detail}", causes=causes)
         if status == 429:
             if path.endswith("/eviction") and self._is_pdb_rejection(payload):
                 # PodDisruptionBudget rejecting the eviction; DrainHelper
@@ -714,6 +726,68 @@ class RestClient:
         return [
             controller_revision_from_json(i) for i in out.get("items", [])
         ]
+
+    # -- custom resources ---------------------------------------------------
+    # Dict-shaped CRUD for CRs (e.g. the TPUUpgradePolicy the generated
+    # CRD in config/crd/ defines).  Mirrors FakeCluster's methods so the
+    # controller reads its policy CR identically on both tiers.
+
+    @staticmethod
+    def _custom_path(
+        group: str, version: str, namespace: str, plural: str, name: str = ""
+    ) -> str:
+        path = f"/apis/{group}/{version}/namespaces/{namespace}/{plural}"
+        return f"{path}/{name}" if name else path
+
+    def create_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        return self._request(
+            "POST", self._custom_path(group, version, namespace, plural),
+            body=obj,
+        )
+
+    def get_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, name: str
+    ) -> dict:
+        return self._request(
+            "GET", self._custom_path(group, version, namespace, plural, name)
+        )
+
+    def update_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        name = (obj.get("metadata") or {}).get("name", "")
+        return self._request(
+            "PUT",
+            self._custom_path(group, version, namespace, plural, name),
+            body=obj,
+        )
+
+    def update_custom_object_status(
+        self, group: str, version: str, plural: str, namespace: str, obj: dict
+    ) -> dict:
+        """PUT to the ``/status`` subresource (the CRD declares it, so
+        status writes through the main resource are stripped)."""
+        name = (obj.get("metadata") or {}).get("name", "")
+        path = self._custom_path(group, version, namespace, plural, name)
+        return self._request("PUT", f"{path}/status", body=obj)
+
+    def delete_custom_object(
+        self, group: str, version: str, plural: str, namespace: str, name: str
+    ) -> None:
+        self._request(
+            "DELETE",
+            self._custom_path(group, version, namespace, plural, name),
+        )
+
+    def list_custom_objects(
+        self, group: str, version: str, plural: str, namespace: str = ""
+    ) -> list[dict]:
+        out = self._request(
+            "GET", self._custom_path(group, version, namespace, plural)
+        )
+        return out.get("items", [])
 
 
 def get_default_client(timeout_s: float = 30.0) -> RestClient:

@@ -1,0 +1,357 @@
+"""Policy-as-CR tier: the consumer-operator loop closed in-repo.
+
+The reference's policy "flows in from the consumer's CRD" (SURVEY §1);
+its consumers own the CRD and the reconcile loop.  Here both are in-repo:
+the generated CRD (config/crd/) registers on the cluster with schema
+admission, the controller reads its policy from the TPUUpgradePolicy CR
+every pass and publishes the upgrade counters to the CR status.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
+from k8s_operator_libs_tpu.api.schema import (
+    POLICY_GROUP,
+    POLICY_PLURAL,
+    POLICY_VERSION,
+    register_policy_crd,
+)
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    InvalidError,
+    KubeApiServer,
+    KubeConfig,
+    NotFoundError,
+    RestClient,
+)
+from k8s_operator_libs_tpu.k8s.client import ConflictError
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+GVP = (POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL)
+
+
+def _cr(name="upgrade-policy", **spec):
+    return {
+        "apiVersion": f"{POLICY_GROUP}/{POLICY_VERSION}",
+        "kind": "TPUUpgradePolicy",
+        "metadata": {"name": name},
+        "spec": spec,
+    }
+
+
+# -- store tier -------------------------------------------------------------
+
+
+def test_unregistered_crd_has_no_routes():
+    cluster = FakeCluster()
+    with pytest.raises(NotFoundError, match="CRD not registered"):
+        cluster.get_custom_object(*GVP, "ns", "p")
+    with pytest.raises(NotFoundError):
+        cluster.create_custom_object(*GVP, "ns", _cr())
+
+
+def test_cr_crud_round_trip():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    created = cluster.create_custom_object(*GVP, "ns", _cr(autoUpgrade=True))
+    assert created["metadata"]["resourceVersion"] == "1"
+    assert created["metadata"]["uid"]
+    got = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    assert got["spec"] == {"autoUpgrade": True}
+    got["spec"]["maxParallelUpgrades"] = 2
+    updated = cluster.update_custom_object(*GVP, "ns", got)
+    assert updated["metadata"]["resourceVersion"] == "2"
+    assert [
+        o["metadata"]["name"] for o in cluster.list_custom_objects(*GVP)
+    ] == ["upgrade-policy"]
+    assert cluster.list_custom_objects(*GVP, namespace="other") == []
+    cluster.delete_custom_object(*GVP, "ns", "upgrade-policy")
+    with pytest.raises(NotFoundError):
+        cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+
+
+def test_cr_admission_rejects_invalid_spec():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    with pytest.raises(InvalidError) as exc:
+        cluster.create_custom_object(
+            *GVP, "ns", _cr(maxParallelUpgrades=-1, drian={"enable": True})
+        )
+    causes = "\n".join(exc.value.causes)
+    assert "spec.maxParallelUpgrades" in causes
+    assert "unknown field" in causes
+    # create must not have stored anything
+    with pytest.raises(NotFoundError):
+        cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    # update path validates too
+    cluster.create_custom_object(*GVP, "ns", _cr(autoUpgrade=True))
+    bad = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    bad["spec"]["unavailabilityUnit"] = "rack"
+    with pytest.raises(InvalidError):
+        cluster.update_custom_object(*GVP, "ns", bad)
+
+
+def test_cr_update_conflicts_on_stale_resource_version():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    cluster.create_custom_object(*GVP, "ns", _cr(autoUpgrade=True))
+    stale = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    fresh = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    cluster.update_custom_object(*GVP, "ns", fresh)
+    with pytest.raises(ConflictError, match="modified"):
+        cluster.update_custom_object(*GVP, "ns", stale)
+
+
+def test_status_subresource_semantics():
+    """The CRD declares subresources.status, so the main resource strips
+    .status writes and /status replaces only .status (apiextensions
+    semantics) — the controller publishes through the subresource."""
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    cluster.create_custom_object(*GVP, "ns", _cr(autoUpgrade=True))
+    cr = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    cr["status"] = {"upgradesDone": 99}
+    updated = cluster.update_custom_object(*GVP, "ns", cr)
+    assert "status" not in updated  # stripped by the main resource
+    cr = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    cr["status"] = {"upgradesDone": 2}
+    cr["spec"]["autoUpgrade"] = False  # must be ignored on /status
+    updated = cluster.update_custom_object_status(*GVP, "ns", cr)
+    assert updated["status"] == {"upgradesDone": 2}
+    assert updated["spec"]["autoUpgrade"] is True
+    # And a later main-resource PUT preserves the stored status.
+    cr = cluster.get_custom_object(*GVP, "ns", "upgrade-policy")
+    cr["spec"]["autoUpgrade"] = False
+    del cr["status"]
+    updated = cluster.update_custom_object(*GVP, "ns", cr)
+    assert updated["status"] == {"upgradesDone": 2}
+    assert updated["spec"]["autoUpgrade"] is False
+
+
+# -- REST tier --------------------------------------------------------------
+
+
+def test_cr_over_rest_wire():
+    store = FakeCluster()
+    register_policy_crd(store)
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        created = client.create_custom_object(
+            *GVP, "ns", _cr(autoUpgrade=True, drain={"enable": True})
+        )
+        assert created["metadata"]["resourceVersion"] == "1"
+        got = client.get_custom_object(*GVP, "ns", "upgrade-policy")
+        assert got["spec"]["drain"] == {"enable": True}
+        got["spec"]["maxUnavailable"] = "50%"
+        updated = client.update_custom_object(*GVP, "ns", got)
+        assert updated["spec"]["maxUnavailable"] == "50%"
+        assert len(client.list_custom_objects(*GVP, namespace="ns")) == 1
+        # Status travels through the /status subresource on the wire.
+        got = client.get_custom_object(*GVP, "ns", "upgrade-policy")
+        got["status"] = {"upgradesDone": 1}
+        updated = client.update_custom_object_status(*GVP, "ns", got)
+        assert updated["status"] == {"upgradesDone": 1}
+        client.delete_custom_object(*GVP, "ns", "upgrade-policy")
+        with pytest.raises(NotFoundError):
+            client.get_custom_object(*GVP, "ns", "upgrade-policy")
+
+
+def test_cr_over_rest_invalid_is_422_with_field_causes():
+    store = FakeCluster()
+    register_policy_crd(store)
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        with pytest.raises(InvalidError) as exc:
+            client.create_custom_object(
+                *GVP, "ns", _cr(healthGate={"minReformationFraction": 2.0})
+            )
+        assert any(
+            "spec.healthGate.minReformationFraction" in c
+            for c in exc.value.causes
+        )
+        # Unregistered plural on the wire is a plain 404.
+        with pytest.raises(NotFoundError):
+            client.get_custom_object(
+                POLICY_GROUP, POLICY_VERSION, "nosuchplural", "ns", "x"
+            )
+
+
+# -- controller tier --------------------------------------------------------
+
+
+def _upgrade_fixture(cluster, keys):
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    return nodes
+
+
+def test_controller_follows_policy_cr_and_reports_status():
+    """autoUpgrade=false CR -> controller idles; flip it to true -> the
+    roll completes; the CR status carries the counters throughout."""
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    keys = UpgradeKeys()
+    nodes = _upgrade_fixture(cluster, keys)
+    cluster.create_custom_object(
+        *GVP,
+        NAMESPACE,
+        _cr(
+            autoUpgrade=False,
+            drain={"enable": True, "timeoutSeconds": 5},
+            healthGate={"enable": False},
+        ),
+    )
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        interval_s=0.01,
+        policy=None,
+        policy_ref=(NAMESPACE, "upgrade-policy"),
+        hbm_floor_fraction=0.0,
+    )
+    controller = UpgradeController(cluster, config)
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+
+    # Paused: several passes change nothing.
+    for _ in range(3):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+    assert all(
+        keys.state_label
+        not in cluster.get_node(n.name, cached=False).labels
+        for n in nodes
+    )
+    # The CR was refreshed into the live config.
+    assert controller.config.policy is not None
+    assert controller.config.policy.auto_upgrade is False
+
+    # Flip the CR: next pass picks it up, roll completes.
+    cr = cluster.get_custom_object(*GVP, NAMESPACE, "upgrade-policy")
+    cr["spec"]["autoUpgrade"] = True
+    cluster.update_custom_object(*GVP, NAMESPACE, cr)
+    for tick in range(40):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+        states = {
+            n.name: cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if all(s == "upgrade-done" for s in states.values()):
+            break
+    else:
+        pytest.fail(f"never converged from CR policy: {states}")
+
+    # Status is the pre-apply snapshot (same as the metrics): one more
+    # pass observes the final state.
+    controller.reconcile_once()
+    status = cluster.get_custom_object(*GVP, NAMESPACE, "upgrade-policy")[
+        "status"
+    ]
+    assert status["upgradesDone"] == 2  # node-granular, reference semantics
+    assert status["totalManagedNodes"] == 2
+    assert status["totalManagedGroups"] == 1
+    assert status["upgradesInProgress"] == 0
+
+
+def test_controller_pauses_when_cr_deleted():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    keys = UpgradeKeys()
+    nodes = _upgrade_fixture(cluster, keys)
+    cluster.create_custom_object(
+        *GVP,
+        NAMESPACE,
+        _cr(
+            autoUpgrade=True,
+            drain={"enable": True, "timeoutSeconds": 5},
+            healthGate={"enable": False},
+        ),
+    )
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        interval_s=0.01,
+        policy=None,
+        policy_ref=(NAMESPACE, "upgrade-policy"),
+        hbm_floor_fraction=0.0,
+    )
+    controller = UpgradeController(cluster, config)
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    controller.reconcile_once()
+    controller.manager.wait_for_async_work(10.0)
+    assert controller.config.policy is not None
+    # Delete the CR mid-roll: the policy gate goes None -> upgrades pause
+    # (reference nil-policy semantics) instead of continuing blind.
+    cluster.delete_custom_object(*GVP, NAMESPACE, "upgrade-policy")
+    controller.reconcile_once()
+    controller.manager.wait_for_async_work(10.0)
+    assert controller.config.policy is None
+    before = {
+        n.name: cluster.get_node(n.name, cached=False).labels.get(
+            keys.state_label, ""
+        )
+        for n in nodes
+    }
+    for _ in range(3):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+    after = {
+        n.name: cluster.get_node(n.name, cached=False).labels.get(
+            keys.state_label, ""
+        )
+        for n in nodes
+    }
+    assert before == after
+
+
+def test_policy_cr_embeds_reference_shaped_spec():
+    """A DriverUpgradePolicySpec-shaped spec (the reference's exact
+    camelCase shape, upgrade_spec.go:27-110) is a valid TPUUpgradePolicy
+    spec — drop-in for consumers migrating from the reference."""
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    cluster.create_custom_object(
+        *GVP,
+        "ns",
+        _cr(
+            autoUpgrade=True,
+            maxParallelUpgrades=0,
+            maxUnavailable="25%",
+            podDeletion={"force": True, "timeoutSeconds": 300},
+            waitForCompletion={"podSelector": "job=training"},
+            drain={
+                "enable": True,
+                "force": True,
+                "podSelector": "",
+                "timeoutSeconds": 300,
+                "deleteEmptyDir": True,
+            },
+        ),
+    )
+    spec = TPUUpgradePolicySpec.from_dict(
+        cluster.get_custom_object(*GVP, "ns", "upgrade-policy")["spec"]
+    )
+    spec.validate()
+    assert spec.max_parallel_upgrades == 0
+    assert spec.wait_for_completion.pod_selector == "job=training"
+    assert isinstance(spec, TPUUpgradePolicySpec)
+    assert spec.drain_spec == DrainSpec(
+        enable=True,
+        force=True,
+        pod_selector="",
+        timeout_second=300,
+        delete_empty_dir=True,
+    )
