@@ -9,14 +9,16 @@ PYTHON ?= python
 
 all: lint test
 
-# Regenerate the TPUUpgradePolicy CRD from api/v1alpha1 (controller-gen
+# Regenerate the TPUUpgradePolicy CRD + state diagram (controller-gen
 # analogue; reference Makefile:60-66 `make generate`).
 generate:
 	$(PYTHON) tools/gen_crd.py
+	$(PYTHON) tools/gen_state_diagram.py
 
 # Fail on generated-file drift (reference ci.yaml go-check job).
 generate-check:
 	$(PYTHON) tools/gen_crd.py --check
+	$(PYTHON) tools/gen_state_diagram.py --check
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -204,6 +204,35 @@ def crd_manifest(
                     "served": True,
                     "storage": True,
                     "subresources": {"status": {}},
+                    # `kubectl get tpuupgradepolicy` shows roll progress
+                    # from the status the controller publishes.
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Auto",
+                            "type": "boolean",
+                            "jsonPath": ".spec.autoUpgrade",
+                        },
+                        {
+                            "name": "Done",
+                            "type": "integer",
+                            "jsonPath": ".status.upgradesDone",
+                        },
+                        {
+                            "name": "In-Progress",
+                            "type": "integer",
+                            "jsonPath": ".status.upgradesInProgress",
+                        },
+                        {
+                            "name": "Failed",
+                            "type": "integer",
+                            "jsonPath": ".status.upgradesFailed",
+                        },
+                        {
+                            "name": "Age",
+                            "type": "date",
+                            "jsonPath": ".metadata.creationTimestamp",
+                        },
+                    ],
                     "schema": {
                         "openAPIV3Schema": {
                             "type": "object",

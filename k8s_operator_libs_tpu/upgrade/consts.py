@@ -99,6 +99,59 @@ IN_PROGRESS_STATES: tuple[UpgradeState, ...] = (
 
 ALL_STATES: tuple[UpgradeState, ...] = tuple(UpgradeState)
 
+# The transition graph of the machine: (from, to, condition).  This is the
+# documented contract of apply_state and its sub-managers; tests assert
+# that every transition the engine performs in the e2e tiers appears here,
+# and tools/gen_state_diagram.py renders it into docs/state-diagram.md
+# (drift-checked by `make generate-check`).  The reference ships a PNG
+# explicitly flagged outdated (docs/automatic-ofed-upgrade.md:85); this
+# one is generated from the table the engine is tested against.
+_S = UpgradeState
+STATE_TRANSITIONS: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
+    (_S.UNKNOWN, _S.UPGRADE_REQUIRED,
+     "driver pod outdated / safe-load wait / upgrade requested"),
+    (_S.UNKNOWN, _S.DONE, "driver pod in sync"),
+    (_S.DONE, _S.UPGRADE_REQUIRED,
+     "new driver revision detected / upgrade requested"),
+    (_S.UPGRADE_REQUIRED, _S.CORDON_REQUIRED,
+     "slot available (or already cordoned); slice complete; DCN ring free"),
+    (_S.CORDON_REQUIRED, _S.WAIT_FOR_JOBS_REQUIRED, "slice cordoned"),
+    (_S.WAIT_FOR_JOBS_REQUIRED, _S.POD_DELETION_REQUIRED,
+     "jobs finished or wait timeout (pod deletion enabled)"),
+    (_S.WAIT_FOR_JOBS_REQUIRED, _S.DRAIN_REQUIRED,
+     "jobs finished or wait timeout (pod deletion disabled)"),
+    (_S.POD_DELETION_REQUIRED, _S.POD_RESTART_REQUIRED,
+     "workload pods evicted"),
+    (_S.POD_DELETION_REQUIRED, _S.DRAIN_REQUIRED,
+     "eviction incomplete, drain enabled (fallback)"),
+    (_S.POD_DELETION_REQUIRED, _S.FAILED,
+     "eviction incomplete, drain disabled"),
+    (_S.DRAIN_REQUIRED, _S.POD_RESTART_REQUIRED,
+     "drain finished (or drain disabled by policy)"),
+    (_S.DRAIN_REQUIRED, _S.FAILED,
+     "drain policy failure (transient faults retry in place)"),
+    (_S.POD_RESTART_REQUIRED, _S.VALIDATION_REQUIRED,
+     "driver pods in sync (pipelined mode uncordons on entry)"),
+    (_S.POD_RESTART_REQUIRED, _S.UNCORDON_REQUIRED,
+     "driver pods in sync + Ready (validation disabled)"),
+    (_S.POD_RESTART_REQUIRED, _S.DONE,
+     "in sync + Ready, validation disabled, all hosts started cordoned"),
+    (_S.POD_RESTART_REQUIRED, _S.FAILED,
+     "new driver pod crash-looping (restarts over threshold)"),
+    (_S.VALIDATION_REQUIRED, _S.UNCORDON_REQUIRED,
+     "health gate passed (slice re-formed, collectives complete)"),
+    (_S.VALIDATION_REQUIRED, _S.DONE,
+     "health gate passed, all hosts started cordoned"),
+    (_S.VALIDATION_REQUIRED, _S.FAILED,
+     "validation timeout (pipelined mode re-cordons + evicts)"),
+    (_S.UNCORDON_REQUIRED, _S.DONE, "slice uncordoned"),
+    (_S.FAILED, _S.UNCORDON_REQUIRED,
+     "auto-recovery: pods back in sync AND health gate passes"),
+    (_S.FAILED, _S.DONE,
+     "auto-recovery (all hosts started cordoned)"),
+)
+del _S
+
 # --- key formats -----------------------------------------------------------
 # Reference: pkg/upgrade/consts.go:20-41 (nvidia.com/%s-driver-upgrade-*).
 # We parameterize the domain as well as the driver name; defaults target a

@@ -1,0 +1,146 @@
+"""The transition table (upgrade.consts.STATE_TRANSITIONS) is the
+documented contract of the engine: every transition observed in a real
+roll must appear in it, every state must be reachable in it, and the
+generated diagram (docs/state-diagram.md) must be current.
+
+The reference ships a state diagram PNG flagged outdated in its own docs
+(reference docs/automatic-ofed-upgrade.md:85); this tier is what makes
+ours unable to rot.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, TPUUpgradePolicySpec
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.upgrade.consts import (
+    ALL_STATES,
+    STATE_TRANSITIONS,
+    UpgradeState,
+    parse_state,
+)
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EDGES = {(src, dst) for src, dst, _ in STATE_TRANSITIONS}
+
+
+def test_table_mentions_every_state():
+    mentioned = {s for e in STATE_TRANSITIONS for s in (e[0], e[1])}
+    assert mentioned == set(ALL_STATES)
+
+
+def test_every_state_has_an_exit():
+    """No terminal traps: DONE re-enters on the next driver bump and
+    FAILED auto-recovers, so every state must have an outgoing edge."""
+    sources = {src for src, _, _ in STATE_TRANSITIONS}
+    assert sources == set(ALL_STATES)
+
+
+def test_generated_diagram_is_current():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "gen_state_diagram.py"),
+            "--check",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class _TransitionRecorder:
+    """Wraps FakeCluster.patch_node_labels to record (from, to) edges."""
+
+    def __init__(self, cluster, keys):
+        self.cluster = cluster
+        self.keys = keys
+        self.observed: set[tuple[UpgradeState, UpgradeState]] = set()
+        self._orig = cluster.patch_node_labels
+        cluster.patch_node_labels = self._wrapped
+
+    def _wrapped(self, name, patch):
+        if self.keys.state_label in patch:
+            old = parse_state(
+                self.cluster.get_node(name, cached=False).labels.get(
+                    self.keys.state_label, ""
+                )
+            )
+            new = parse_state(patch[self.keys.state_label] or "")
+            if old != new:
+                self.observed.add((old, new))
+        return self._orig(name, patch)
+
+
+def _run(mgr, cluster, keys, nodes, policy, want, max_ticks=60):
+    for _ in range(max_ticks):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        states = {
+            n.name: cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if all(s == want for s in states.values()):
+            return
+    pytest.fail(f"never reached {want}: {states}")
+
+
+def test_observed_transitions_are_documented():
+    """Happy roll + drain-failure + recovery: every engine-performed
+    transition must be a documented edge, and the core chain must have
+    been exercised (an empty observation would vacuously pass)."""
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(cluster, keys)
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    # An undrainable workload pod (PDB) with a short drain timeout drives
+    # the FAILED edge first.
+    workload = fx.workload_pod(nodes[0], name="pdb-blocked", namespace=NAMESPACE)
+    cluster.set_eviction_blocked(NAMESPACE, workload.name, True)
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        drain_spec=DrainSpec(enable=True, timeout_second=1),
+    )
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    mgr.recovery_probe_backoff_s = 0
+    _run(mgr, cluster, keys, nodes, policy, "upgrade-failed")
+    # Heal: unblock the PDB, restart the old-revision driver pods so the
+    # group is back in sync (the documented FAILED runbook), and converge.
+    cluster.set_eviction_blocked(NAMESPACE, workload.name, False)
+    for n in nodes:
+        cluster.delete_pod(NAMESPACE, f"driver-{n.name}")
+    _run(mgr, cluster, keys, nodes, policy, "upgrade-done")
+
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, f"undocumented transitions: {undocumented}"
+    core = {
+        (UpgradeState.UNKNOWN, UpgradeState.UPGRADE_REQUIRED),
+        (UpgradeState.UPGRADE_REQUIRED, UpgradeState.CORDON_REQUIRED),
+        (UpgradeState.CORDON_REQUIRED, UpgradeState.WAIT_FOR_JOBS_REQUIRED),
+        (UpgradeState.DRAIN_REQUIRED, UpgradeState.FAILED),
+        (UpgradeState.UNCORDON_REQUIRED, UpgradeState.DONE),
+    }
+    missing = core - recorder.observed
+    assert not missing, f"core transitions not exercised: {missing}"
