@@ -202,6 +202,11 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
     probe_interval_s: float = 30.0
     deep: bool = False
     driver_revision: str = ""
+    # "host[:port]" peer-slice endpoints across the DCN; when set the
+    # agents run the dcn_reachability check (SliceHealthGateSpec.dcn_check
+    # gates on it).  In a JobSet deployment these are the peer slices'
+    # headless-service addresses.
+    dcn_peers: tuple[str, ...] = ()
 
     # RollingUpdate is the point: a template change (new DRIVER_REVISION)
     # must restart the agent pods, or they would keep publishing reports
@@ -224,6 +229,10 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
         ]
         if self.deep:
             env.append({"name": "HEALTH_DEEP_PROBE", "value": "1"})
+        if self.dcn_peers:
+            env.append(
+                {"name": "HEALTH_DCN_PEERS", "value": ",".join(self.dcn_peers)}
+            )
         pod["containers"] = [
             {
                 "name": "health-agent",

@@ -111,6 +111,7 @@ class HealthAgent:
         hbm_mib: int = 1024,
         allreduce_elems: int = 1 << 20,
         deep: bool = False,
+        dcn_peers: Optional[Sequence[str]] = None,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -122,6 +123,9 @@ class HealthAgent:
         self.hbm_mib = hbm_mib
         self.allreduce_elems = allreduce_elems
         self.deep = deep
+        # "host[:port]" peer-slice endpoints across the DCN; when set the
+        # battery includes dcn_reachability (BASELINE config 5).
+        self.dcn_peers = list(dcn_peers) if dcn_peers else None
 
     def probe_once(self) -> HealthReport:
         checks = run_host_probe(
@@ -130,6 +134,7 @@ class HealthAgent:
             hbm_mib=self.hbm_mib,
             allreduce_elems=self.allreduce_elems,
             deep=self.deep,
+            dcn_peers=self.dcn_peers,
         )
         # Derive the visible-device count from the enumeration check
         # rather than re-calling jax.devices(): when libtpu is broken (the
@@ -193,6 +198,12 @@ def main() -> None:
         driver_revision=os.environ.get(DRIVER_REVISION_ENV, ""),
         slice_wide=slice_wide,
         deep=os.environ.get("HEALTH_DEEP_PROBE", "") == "1",
+        dcn_peers=[
+            p.strip()
+            for p in os.environ.get("HEALTH_DCN_PEERS", "").split(",")
+            if p.strip()
+        ]
+        or None,
     )
     interval = float(os.environ.get("HEALTH_PROBE_INTERVAL_S", "30"))
     agent.run_forever(interval)

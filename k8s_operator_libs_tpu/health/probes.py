@@ -674,6 +674,66 @@ def ici_ring_attention_probe(
     )
 
 
+# Default TPU runtime gRPC port (what peer-slice hosts listen on).
+DCN_DEFAULT_PORT = 8471
+
+
+def dcn_reachability_probe(
+    peers: Sequence[str], timeout_s: float = 2.0
+) -> CheckResult:
+    """TCP reachability to peer-slice hosts across the DCN.
+
+    In a multi-slice deployment (DCN data-parallel, BASELINE config 5)
+    every host must reach the peer slices' hosts or the whole JobSet
+    stalls at the next cross-slice collective.  ICI probes can't see
+    this — the slice itself re-forms fine with a broken DCN path — so
+    it's a separate check, gated by SliceHealthGateSpec.dcn_check.
+    ``peers`` are "host[:port]" (default port: the TPU runtime's gRPC
+    port); reachability is a TCP connect, the same signal a gRPC channel
+    setup would give, without needing the peer mid-collective.
+    """
+    import socket
+    from concurrent.futures import ThreadPoolExecutor
+
+    def parse(peer: str) -> tuple[str, int]:
+        # "host", "host:port", "[v6]:port", or a bare IPv6 literal.
+        if peer.startswith("["):
+            host, _, rest = peer[1:].partition("]")
+            port = rest.lstrip(":")
+        elif peer.count(":") > 1:
+            host, port = peer, ""
+        else:
+            host, _, port = peer.partition(":")
+        return host, int(port or DCN_DEFAULT_PORT)
+
+    def connect(peer: str) -> Optional[str]:
+        try:
+            with socket.create_connection(parse(peer), timeout=timeout_s):
+                return None
+        except (OSError, ValueError) as e:
+            return f"{peer} ({e})"
+
+    t0 = time.perf_counter()
+    # Concurrent connects: total probe time stays ~one timeout even with
+    # many unreachable peers (a partitioned DCN must not make the probe
+    # itself so slow that reports go stale and mask the real failure).
+    with ThreadPoolExecutor(max_workers=min(32, max(1, len(peers)))) as pool:
+        failures = list(pool.map(connect, peers))
+    unreachable = [f for f in failures if f is not None]
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    reachable = len(peers) - len(unreachable)
+    detail = f"{reachable}/{len(peers)} DCN peer(s) reachable"
+    if unreachable:
+        detail += ": unreachable " + "; ".join(unreachable)
+    return CheckResult(
+        "dcn_reachability",
+        not unreachable,
+        elapsed_ms,
+        detail,
+        metrics={"peers": float(len(peers)), "reachable": float(reachable)},
+    )
+
+
 def run_host_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
@@ -683,6 +743,7 @@ def run_host_probe(
     skip_ici: bool = False,
     deep: bool = False,
     min_time_s: float = DEFAULT_MIN_TIME_S,
+    dcn_peers: Optional[Sequence[str]] = None,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
 
@@ -729,4 +790,6 @@ def run_host_probe(
         results.append(ici_ring_probe(devs))
         if deep:
             results.append(ici_ring_attention_probe(devs))
+    if dcn_peers:
+        results.append(dcn_reachability_probe(dcn_peers))
     return results

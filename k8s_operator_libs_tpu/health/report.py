@@ -20,7 +20,8 @@ from dataclasses import dataclass, field
 from k8s_operator_libs_tpu.health.probes import CheckResult
 
 # Every check `run_host_probe` can emit, in emission order
-# (ici_ring_attention only with deep=True).
+# (ici_ring_attention only with deep=True; dcn_reachability only when
+# the agent is configured with DCN peers).
 HEALTH_CHECKS_ALL = (
     "device_enumeration",
     "mxu_matmul",
@@ -28,6 +29,7 @@ HEALTH_CHECKS_ALL = (
     "ici_allreduce",
     "ici_ring",
     "ici_ring_attention",
+    "dcn_reachability",
 )
 
 

@@ -307,7 +307,7 @@ class ClusterUpgradeStateManager:
             {s.value or "unknown": len(current_state.nodes_in(s)) for s in UpgradeState},
         )
 
-        # TPU health-gate knobs: validation timeout + gate disable.
+        # TPU health-gate knobs: validation timeout + gate disable + DCN.
         validation_active = self.is_validation_enabled()
         if isinstance(policy, TPUUpgradePolicySpec) and policy.health_gate is not None:
             if policy.health_gate.timeout_second:
@@ -316,6 +316,16 @@ class ClusterUpgradeStateManager:
                 )
             if not policy.health_gate.enable:
                 validation_active = False
+        # Set unconditionally (not only when a health gate is configured):
+        # a leftover True from a previous policy must not keep rejecting
+        # reports after the DCN gate is turned off.
+        prober = getattr(self.validation_manager, "prober", None)
+        if prober is not None and hasattr(prober, "require_dcn_check"):
+            prober.require_dcn_check = bool(
+                isinstance(policy, TPUUpgradePolicySpec)
+                and policy.health_gate is not None
+                and policy.health_gate.dcn_check
+            )
 
         pipeline = (
             isinstance(policy, TPUUpgradePolicySpec)

@@ -71,7 +71,7 @@ def test_agent_daemon_set_shape():
 
     spec = AgentDaemonSetSpec(
         version="1.0", driver_revision="rev-7", probe_interval_s=15.0,
-        deep=True,
+        deep=True, dcn_peers=("peer-0.slice-b:8471", "peer-0.slice-c"),
     )
     ds = build_daemon_set(spec)
     pod = ds.spec.template.pod_spec
@@ -81,6 +81,7 @@ def test_agent_daemon_set_shape():
     assert env["DRIVER_REVISION"] == "rev-7"
     assert env["HEALTH_PROBE_INTERVAL_S"] == "15.0"
     assert env["HEALTH_DEEP_PROBE"] == "1"
+    assert env["HEALTH_DCN_PEERS"] == "peer-0.slice-b:8471,peer-0.slice-c"
     # Must keep probing cordoned hosts mid-upgrade.
     assert any(
         t["key"] == "node.kubernetes.io/unschedulable"

@@ -476,3 +476,181 @@ def test_agent_publishes_report_and_prober_reads_it(cpu_devices):
     prober = NodeReportProber(KEYS, revision_resolver=None)
     res = prober.probe(group)
     assert res.healthy, res.detail
+
+
+# --- DCN reachability (SliceHealthGateSpec.dcn_check) -----------------------
+
+
+def _listening_socket():
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    return s
+
+
+def test_dcn_probe_reachable_and_not():
+    from k8s_operator_libs_tpu.health.probes import dcn_reachability_probe
+
+    listener = _listening_socket()
+    port = listener.getsockname()[1]
+    try:
+        ok = dcn_reachability_probe([f"127.0.0.1:{port}"], timeout_s=2.0)
+        assert ok.ok
+        assert ok.metrics == {"peers": 1.0, "reachable": 1.0}
+        # A bound-then-closed port refuses fast: deterministic failure.
+        dead = _listening_socket()
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        mixed = dcn_reachability_probe(
+            [f"127.0.0.1:{port}", f"127.0.0.1:{dead_port}"], timeout_s=2.0
+        )
+        assert not mixed.ok
+        assert mixed.metrics["reachable"] == 1.0
+        assert f"127.0.0.1:{dead_port}" in mixed.detail
+    finally:
+        listener.close()
+
+
+def test_dcn_probe_parses_bracketed_and_bare_v6_peers():
+    from k8s_operator_libs_tpu.health.probes import dcn_reachability_probe
+
+    listener = _listening_socket()
+    port = listener.getsockname()[1]
+    try:
+        # Bracketed form: the port must be split off the bracket, not the
+        # first colon.
+        res = dcn_reachability_probe([f"[127.0.0.1]:{port}"], timeout_s=2.0)
+        assert res.ok, res.detail
+        # A bare IPv6 literal must be treated as host-only (default port),
+        # not chopped at the first colon into host 'fd00' port ':1'.
+        res = dcn_reachability_probe(["fd00::1"], timeout_s=0.2)
+        assert not res.ok
+        assert "fd00::1" in res.detail  # whole literal, not a fragment
+    finally:
+        listener.close()
+
+
+def test_dcn_probe_unreachable_peers_checked_concurrently():
+    """A partitioned DCN (many dead peers) must cost ~one timeout, not
+    timeout x peers — otherwise the probe itself delays the report until
+    staleness masks the real failure."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.health.probes import dcn_reachability_probe
+
+    dead = []
+    for _ in range(6):
+        s = _listening_socket()
+        dead.append(f"127.0.0.1:{s.getsockname()[1]}")
+        s.close()
+    t0 = _time.monotonic()
+    res = dcn_reachability_probe(dead, timeout_s=1.0)
+    elapsed = _time.monotonic() - t0
+    assert not res.ok
+    assert res.metrics["reachable"] == 0.0
+    assert elapsed < 3.0, f"sequential-looking probe: {elapsed:.1f}s"
+
+
+def test_agent_with_peers_publishes_dcn_check(cpu_devices):
+    listener = _listening_socket()
+    port = listener.getsockname()[1]
+    cluster = FakeCluster()
+    cluster.create_node(make_node("host-0"))
+    try:
+        agent = HealthAgent(
+            cluster,
+            "host-0",
+            KEYS,
+            devices=cpu_devices,
+            dcn_peers=[f"127.0.0.1:{port}"],
+            **SMALL,
+        )
+        report = agent.run_once()
+        assert any(c.name == "dcn_reachability" for c in report.checks)
+        assert report.healthy
+    finally:
+        listener.close()
+
+
+def _dcn_slice_info():
+    info = _v5p_slice_info()
+    info.dcn_group = "ring-a"
+    return info
+
+
+def test_prober_requires_dcn_check_for_dcn_grouped_slices():
+    from k8s_operator_libs_tpu.health.probes import CheckResult
+
+    # Reports WITHOUT the dcn check: fine normally, rejected when the
+    # gate demands DCN coverage for a multi-slice group.
+    reports = [_healthy_report(f"host-{i}") for i in range(4)]
+    group = _group(_slice_nodes_with_reports(reports), _dcn_slice_info())
+    prober = NodeReportProber(KEYS)
+    assert prober.probe(group).healthy
+    prober.require_dcn_check = True
+    res = prober.probe(group)
+    assert not res.healthy
+    assert "dcn_reachability" in res.detail
+    # Same gate on a slice with no DCN group: not required.
+    single = _group(
+        _slice_nodes_with_reports(
+            [_healthy_report(f"host-{i}") for i in range(4)]
+        ),
+        _v5p_slice_info(),
+    )
+    assert prober.probe(single).healthy
+    # Reports WITH a passing dcn check satisfy the gate.
+    with_dcn = []
+    for i in range(4):
+        rep = _healthy_report(f"host-{i}")
+        rep.checks.append(CheckResult("dcn_reachability", True, 1.0))
+        with_dcn.append(rep)
+    group2 = _group(_slice_nodes_with_reports(with_dcn), _dcn_slice_info())
+    assert prober.probe(group2).healthy
+    # And a FAILING dcn check rejects via the generic failed-check path.
+    with_bad = []
+    for i in range(4):
+        rep = _healthy_report(f"host-{i}")
+        rep.checks.append(
+            CheckResult("dcn_reachability", False, 1.0, "peer unreachable")
+        )
+        with_bad.append(rep)
+    group3 = _group(_slice_nodes_with_reports(with_bad), _dcn_slice_info())
+    res = prober.probe(group3)
+    assert not res.healthy and "peer unreachable" in res.detail
+
+
+def test_apply_state_pushes_dcn_check_to_prober():
+    from k8s_operator_libs_tpu.api import SliceHealthGateSpec, TPUUpgradePolicySpec
+    from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager
+    from k8s_operator_libs_tpu.upgrade.upgrade_state import ClusterUpgradeState
+
+    cluster = FakeCluster()
+    mgr = ClusterUpgradeStateManager(cluster, keys=KEYS)
+    prober = NodeReportProber(KEYS)
+    mgr.with_validation_enabled(prober)
+    assert prober.require_dcn_check is False
+    mgr.apply_state(
+        ClusterUpgradeState(),
+        TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            health_gate=SliceHealthGateSpec(dcn_check=True),
+        ),
+    )
+    assert prober.require_dcn_check is True
+    mgr.apply_state(
+        ClusterUpgradeState(),
+        TPUUpgradePolicySpec(auto_upgrade=True),
+    )
+    assert prober.require_dcn_check is False
+    # A policy with NO health gate (or a base DriverUpgradePolicySpec)
+    # must also clear a leftover True — not leave it stale.
+    from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+
+    prober.require_dcn_check = True
+    mgr.apply_state(
+        ClusterUpgradeState(), DriverUpgradePolicySpec(auto_upgrade=True)
+    )
+    assert prober.require_dcn_check is False
