@@ -1,0 +1,141 @@
+"""NodeUpgradeStateProvider failure paths: patch errors, cache-sync
+timeouts, and the NotFound-while-polling window (reference
+node_upgrade_state_provider_test.go covers the happy paths; these pin the
+error contract — Warning events + typed exceptions — that the chaos tier
+relies on)."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys, UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    CacheSyncTimeout,
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import make_node
+
+KEYS = UpgradeKeys()
+
+
+def _provider(cluster, **kw):
+    events = EventRecorder()
+    kw.setdefault("poll_interval_s", 0.005)
+    kw.setdefault("poll_timeout_s", 0.2)
+    return NodeUpgradeStateProvider(
+        cluster, KEYS, event_recorder=events, **kw
+    ), events
+
+
+def test_patch_failure_raises_and_records_warning():
+    cluster = FakeCluster()
+    node = cluster.create_node(make_node("n0"))
+
+    def fail_patch(verb):
+        if verb == "patch_node":
+            raise RuntimeError("injected apiserver fault")
+
+    cluster.fault_injector = fail_patch
+    provider, events = _provider(cluster)
+    with pytest.raises(RuntimeError, match="injected"):
+        provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    warning = [e for e in events.drain() if e.event_type == "Warning"]
+    assert warning and "Failed to update node state label" in warning[0].message
+
+
+def test_label_cache_sync_timeout_raises_with_seen_value():
+    # Cache lag far beyond the poll timeout: the write never becomes
+    # visible, the provider must raise CacheSyncTimeout naming what the
+    # cache DID show, and record a Warning event.
+    cluster = FakeCluster(cache_lag_s=60.0)
+    node = make_node("n0")
+    cluster.create_node(node)
+    provider, events = _provider(cluster)
+    with pytest.raises(CacheSyncTimeout, match="not.*visible|visible"):
+        provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    warning = [e for e in events.drain() if e.event_type == "Warning"]
+    assert warning and "cache sync timeout" in warning[0].message
+
+
+def test_label_write_converges_through_not_found_window():
+    """A just-created node is invisible to the lagged cache: the poll
+    loop must ride through NotFoundError until the cache catches up."""
+    cluster = FakeCluster(cache_lag_s=0.05)
+    node = make_node("n0")
+    cluster.create_node(node)
+    provider, events = _provider(cluster, poll_timeout_s=2.0)
+    provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    # Caller's object was refreshed from the now-visible cache read.
+    assert node.labels[KEYS.state_label] == "cordon-required"
+    normal = [e for e in events.drain() if e.event_type == "Normal"]
+    assert normal
+
+
+def test_annotation_set_delete_and_timeout():
+    cluster = FakeCluster(cache_lag_s=0.05)
+    node = make_node("n0")
+    cluster.create_node(node)
+    provider, _ = _provider(cluster, poll_timeout_s=2.0)
+    key = KEYS.initial_state_annotation
+    provider.change_node_upgrade_annotation(node, key, "true")
+    assert node.annotations[key] == "true"
+    # "null" deletes (reference node_upgrade_state_provider.go:147-150).
+    provider.change_node_upgrade_annotation(node, key, "null")
+    assert key not in node.annotations
+
+    # Timeout path: lag beyond the poll window.
+    slow = FakeCluster(cache_lag_s=60.0)
+    node2 = make_node("n1")
+    slow.create_node(node2)
+    provider2, events2 = _provider(slow)
+    with pytest.raises(CacheSyncTimeout, match="annotation"):
+        provider2.change_node_upgrade_annotation(node2, key, "true")
+    warning = [e for e in events2.drain() if e.event_type == "Warning"]
+    assert warning and "cache sync timeout" in warning[0].message
+
+
+def test_unknown_state_deletes_the_label():
+    cluster = FakeCluster()
+    node = make_node("n0")
+    cluster.create_node(node)
+    provider, _ = _provider(cluster, poll_timeout_s=2.0)
+    provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    provider.change_node_upgrade_state(node, UpgradeState.UNKNOWN)
+    live = cluster.get_node("n0", cached=False)
+    assert KEYS.state_label not in live.labels
+
+
+def test_batch_write_reports_first_failure_but_attempts_all():
+    cluster = FakeCluster()
+    nodes = [make_node(f"n{i}") for i in range(4)]
+    for n in nodes:
+        cluster.create_node(n)
+
+    import itertools
+
+    # The injector runs concurrently from the batch's worker threads:
+    # itertools.count is atomic under the GIL, a bare int += is not.
+    counter = itertools.count(1)
+
+    def fail_second(verb):
+        if verb == "patch_node" and next(counter) == 2:
+            raise RuntimeError("injected fault on one member")
+
+    cluster.fault_injector = fail_second
+    provider, _ = _provider(cluster, poll_timeout_s=2.0)
+    with pytest.raises(RuntimeError, match="injected"):
+        provider.change_nodes_upgrade_state(
+            nodes, UpgradeState.CORDON_REQUIRED
+        )
+    cluster.fault_injector = None
+    # All other members were still attempted (partial slice: next pass
+    # re-drives via effective_state) — at least 3 of 4 carry the label.
+    labeled = sum(
+        1
+        for n in nodes
+        if cluster.get_node(n.name, cached=False).labels.get(KEYS.state_label)
+        == "cordon-required"
+    )
+    assert labeled == 3

@@ -1,0 +1,277 @@
+"""PodManager edge paths, mirroring the reference's pod_manager_test.go
+tier: revision-hash errors, wait-for-jobs stamping, eviction failure
+fallbacks, and restart error events."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import PodDeletionSpec, WaitForCompletionSpec
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.objects import (
+    DaemonSet,
+    DaemonSetSpec,
+    LabelSelectorSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.pod_manager import (
+    PodManager,
+    PodManagerConfig,
+)
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import ClusterFixture, NAMESPACE, make_node
+
+KEYS = UpgradeKeys()
+
+
+def _pm(cluster, pod_deletion_filter=None):
+    events = EventRecorder()
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    return (
+        PodManager(
+            cluster,
+            provider,
+            KEYS,
+            pod_deletion_filter=pod_deletion_filter,
+            event_recorder=events,
+            poll_interval_s=0.005,
+        ),
+        events,
+    )
+
+
+def _group(nodes):
+    return UpgradeGroup(
+        id=nodes[0].name,
+        members=[NodeUpgradeState(node=n) for n in nodes],
+    )
+
+
+def _state_of(cluster, nodes):
+    return {
+        n.name: cluster.get_node(n.name, cached=False).labels.get(
+            KEYS.state_label, ""
+        )
+        for n in nodes
+    }
+
+
+# -- revision hashes ---------------------------------------------------------
+
+
+def test_pod_without_revision_hash_label_raises():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    node = make_node("n0")
+    cluster.create_node(node)
+    pod = fx.workload_pod(node, namespace=NAMESPACE)
+    pm, _ = _pm(cluster)
+    with pytest.raises(ValueError, match="controller-revision-hash"):
+        pm.get_pod_controller_revision_hash(pod)
+
+
+def test_daemonset_without_revisions_raises():
+    cluster = FakeCluster()
+    ds = DaemonSet(
+        metadata=ObjectMeta(name="bare-ds", namespace=NAMESPACE),
+        spec=DaemonSetSpec(
+            selector=LabelSelectorSpec(match_labels={"app": "x"}),
+            template=PodTemplateSpec(labels={"app": "x"}),
+        ),
+    )
+    cluster.create_daemon_set(ds)
+    pm, _ = _pm(cluster)
+    with pytest.raises(ValueError, match="no revision found"):
+        pm.get_daemonset_controller_revision_hash(ds)
+
+
+# -- wait-for-jobs -----------------------------------------------------------
+
+
+def test_wait_spec_none_raises():
+    pm, _ = _pm(FakeCluster())
+    with pytest.raises(ValueError, match="wait-for-completion spec"):
+        pm.schedule_check_on_pod_completion(PodManagerConfig(groups=[]))
+
+
+def test_wait_timeout_stamps_then_advances():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    nodes = [make_node("n0"), make_node("n1")]
+    for n in nodes:
+        cluster.create_node(n)
+    # A running workload pod on each host keeps the group waiting.
+    for n in nodes:
+        fx.workload_pod(n, labels={"job": "train"}, namespace=NAMESPACE)
+    pm, _ = _pm(cluster)
+    spec = WaitForCompletionSpec(pod_selector="job=train", timeout_second=1)
+    key = KEYS.pod_completion_start_time_annotation
+
+    # Pass 1: nodes get the start-time annotation stamped, no transition.
+    pm.schedule_check_on_pod_completion(
+        PodManagerConfig(groups=[_group(nodes)], wait_for_completion_spec=spec)
+    )
+    fresh = [cluster.get_node(n.name, cached=False) for n in nodes]
+    assert all(key in n.annotations for n in fresh)
+    assert all(
+        KEYS.state_label not in n.labels for n in fresh
+    )  # still waiting
+
+    # Pass 2 after the timeout: group advances and annotation clears.
+    # (annotation stamps are whole seconds: sleep past timeout+1 so
+    # int(now) > start + timeout regardless of truncation)
+    time.sleep(2.1)
+    pm.schedule_check_on_pod_completion(
+        PodManagerConfig(
+            groups=[_group(fresh)], wait_for_completion_spec=spec
+        )
+    )
+    done = [cluster.get_node(n.name, cached=False) for n in nodes]
+    assert all(
+        n.labels.get(KEYS.state_label) == "pod-deletion-required"
+        for n in done
+    )
+    assert all(key not in n.annotations for n in done)
+
+
+# -- eviction ----------------------------------------------------------------
+
+
+def test_eviction_config_errors():
+    pm, _ = _pm(FakeCluster())
+    # Empty groups: no-op, no error.
+    pm.schedule_pod_eviction(
+        PodManagerConfig(groups=[], deletion_spec=PodDeletionSpec())
+    )
+    g = _group([make_node("n0")])
+    with pytest.raises(ValueError, match="deletion spec"):
+        pm.schedule_pod_eviction(PodManagerConfig(groups=[g]))
+    with pytest.raises(ValueError, match="filter"):
+        pm.schedule_pod_eviction(
+            PodManagerConfig(groups=[g], deletion_spec=PodDeletionSpec())
+        )
+
+
+def test_eviction_with_no_matching_pods_advances_to_restart():
+    cluster = FakeCluster()
+    nodes = [make_node("n0")]
+    for n in nodes:
+        cluster.create_node(n)
+    pm, _ = _pm(cluster, pod_deletion_filter=lambda p: False)
+    pm.schedule_pod_eviction(
+        PodManagerConfig(
+            groups=[_group(nodes)], deletion_spec=PodDeletionSpec()
+        )
+    )
+    assert pm.wait_idle(10.0)
+    assert _state_of(cluster, nodes) == {"n0": "pod-restart-required"}
+
+
+def test_eviction_delete_failure_falls_back_to_drain_with_events():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    nodes = [make_node("n0")]
+    for n in nodes:
+        cluster.create_node(n)
+    fx.workload_pod(nodes[0], name="victim", namespace=NAMESPACE)
+
+    def fail_delete(verb):
+        if verb in ("delete_pod", "evict_pod"):
+            raise RuntimeError("injected delete failure")
+
+    cluster.fault_injector = fail_delete
+    pm, events = _pm(cluster, pod_deletion_filter=lambda p: True)
+    pm.schedule_pod_eviction(
+        PodManagerConfig(
+            groups=[_group(nodes)],
+            deletion_spec=PodDeletionSpec(force=True, timeout_second=1),
+            drain_enabled=True,
+        )
+    )
+    assert pm.wait_idle(15.0)
+    cluster.fault_injector = None
+    assert _state_of(cluster, nodes) == {"n0": "drain-required"}
+    warning = [e for e in events.drain() if e.event_type == "Warning"]
+    assert warning and "Failed to delete workload pods" in warning[0].message
+
+
+def test_eviction_delete_failure_without_drain_fails_group():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    nodes = [make_node("n0")]
+    for n in nodes:
+        cluster.create_node(n)
+    fx.workload_pod(nodes[0], name="victim", namespace=NAMESPACE)
+
+    def fail_delete(verb):
+        if verb in ("delete_pod", "evict_pod"):
+            raise RuntimeError("injected delete failure")
+
+    cluster.fault_injector = fail_delete
+    pm, _ = _pm(cluster, pod_deletion_filter=lambda p: True)
+    pm.schedule_pod_eviction(
+        PodManagerConfig(
+            groups=[_group(nodes)],
+            deletion_spec=PodDeletionSpec(force=True, timeout_second=1),
+            drain_enabled=False,
+        )
+    )
+    assert pm.wait_idle(15.0)
+    cluster.fault_injector = None
+    assert _state_of(cluster, nodes) == {"n0": "upgrade-failed"}
+
+
+def test_eviction_dedups_in_flight_groups():
+    cluster = FakeCluster()
+    nodes = [make_node("n0")]
+    for n in nodes:
+        cluster.create_node(n)
+    pm, _ = _pm(cluster, pod_deletion_filter=lambda p: False)
+    g = _group(nodes)
+    pm._groups_in_progress.add(g.id)  # simulate an in-flight worker
+    pm.schedule_pod_eviction(
+        PodManagerConfig(groups=[g], deletion_spec=PodDeletionSpec())
+    )
+    assert pm.wait_idle(5.0)
+    # Deduped: no state was written by a second worker.
+    assert _state_of(cluster, nodes) == {"n0": ""}
+    pm._groups_in_progress.remove(g.id)
+
+
+# -- restart -----------------------------------------------------------------
+
+
+def test_restart_no_pods_is_noop():
+    pm, _ = _pm(FakeCluster())
+    pm.schedule_pods_restart([])  # must not raise
+
+
+def test_restart_delete_failure_raises_and_records_event():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    node = make_node("n0")
+    cluster.create_node(node)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    pod = fx.driver_pod(node, ds, hash_suffix="v1")
+
+    def fail_delete(verb):
+        if verb == "delete_pod":
+            raise RuntimeError("injected delete failure")
+
+    cluster.fault_injector = fail_delete
+    pm, events = _pm(cluster)
+    with pytest.raises(RuntimeError, match="injected"):
+        pm.schedule_pods_restart([pod])
+    cluster.fault_injector = None
+    warning = [e for e in events.drain() if e.event_type == "Warning"]
+    assert warning and "Failed to restart driver pod" in warning[0].message

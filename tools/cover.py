@@ -63,11 +63,33 @@ def _executable_lines(path: str) -> set[int]:
     return lines
 
 
+def _ranges(lines: list[int]) -> str:
+    """Compress [3,4,5,9] to '3-5, 9'."""
+    out = []
+    i = 0
+    while i < len(lines):
+        j = i
+        while j + 1 < len(lines) and lines[j + 1] == lines[j] + 1:
+            j += 1
+        out.append(
+            str(lines[i]) if i == j else f"{lines[i]}-{lines[j]}"
+        )
+        i = j + 1
+    return ", ".join(out)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threshold", type=float, default=70.0)
     parser.add_argument(
         "--report", action="store_true", help="per-file detail"
+    )
+    parser.add_argument(
+        "--missing",
+        default="",
+        metavar="SUBSTR",
+        help="also print missed line numbers for files whose path "
+        "contains SUBSTR",
     )
     parser.add_argument("pytest_args", nargs="*", default=[])
     args = parser.parse_args()
@@ -100,8 +122,11 @@ def main() -> int:
             total_exec += len(executable)
             total_hit += len(hit)
             pct = 100.0 * len(hit) / len(executable) if executable else 100.0
-            rows.append((os.path.relpath(path, REPO_ROOT), pct,
-                         len(hit), len(executable)))
+            rel = os.path.relpath(path, REPO_ROOT)
+            rows.append((rel, pct, len(hit), len(executable)))
+            missed = sorted(executable - hit)
+            if args.missing and args.missing in rel and missed:
+                print(f"{rel} missing: {_ranges(missed)}")
 
     if args.report:
         for rel, pct, hit, executable in rows:
