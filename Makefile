@@ -14,11 +14,13 @@ all: lint test
 generate:
 	$(PYTHON) tools/gen_crd.py
 	$(PYTHON) tools/gen_state_diagram.py
+	$(PYTHON) tools/gen_manifests.py
 
 # Fail on generated-file drift (reference ci.yaml go-check job).
 generate-check:
 	$(PYTHON) tools/gen_crd.py --check
 	$(PYTHON) tools/gen_state_diagram.py --check
+	$(PYTHON) tools/gen_manifests.py --check
 
 test:
 	$(PYTHON) -m pytest tests/ -q

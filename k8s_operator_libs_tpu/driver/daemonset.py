@@ -60,6 +60,12 @@ class DriverDaemonSetSpec:
     safe_load: bool = True
     env: dict[str, str] = field(default_factory=dict)
     extra_labels: dict[str, str] = field(default_factory=dict)
+    # ServiceAccount the pods run under.  Both pod kinds talk to the
+    # apiserver (the safe-load init container sets/polls its node
+    # annotation; the agent publishes health reports), so on an RBAC
+    # cluster the default SA would 403.  config/manifests/ creates this
+    # account bound to the node-reporter ClusterRole.
+    service_account: str = "tpu-node-reporter"
 
     @property
     def selector_labels(self) -> dict[str, str]:
@@ -99,6 +105,7 @@ def _base_pod(spec: DriverDaemonSetSpec) -> tuple[dict, list]:
     )
     pod: dict = {
         "priorityClassName": "system-node-critical",
+        "serviceAccountName": spec.service_account,
         "hostNetwork": True,
         "tolerations": [
             # TPU nodes carry the google.com/tpu taint; driver and agent

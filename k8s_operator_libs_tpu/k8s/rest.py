@@ -449,7 +449,10 @@ class RestClient:
     @staticmethod
     def _stat_key(method: str, path: str) -> str:
         """Bounded stats key: verb + resource kind (names stripped), so a
-        weeks-long controller doesn't grow the Counter per object."""
+        weeks-long controller doesn't grow the Counter per object.  Custom
+        resources key by their plural (+"/status" for the subresource) so
+        the RBAC coverage check (manifests.required_grants) can attribute
+        them."""
         parts = [p for p in path.split("/") if p]
         kind = "?"
         for known in (
@@ -462,6 +465,13 @@ class RestClient:
             if known in parts:
                 kind = known
                 break
+        else:
+            # /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}
+            # [/status]] — a custom resource.
+            if len(parts) >= 6 and parts[0] == "apis" and parts[3] == "namespaces":
+                kind = parts[5]
+                if parts[-1] == "status":
+                    kind += "/status"
         return f"{method} {kind}"
 
     @staticmethod

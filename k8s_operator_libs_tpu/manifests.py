@@ -1,0 +1,242 @@
+"""Deployment manifests for the controller and probe agents.
+
+The reference is a library and ships no manifests — its consumers (GPU /
+Network Operator) own deployment.  Here the consumer operator is in-repo
+(controller.py), so the install surface is too: ServiceAccounts, RBAC
+scoped to exactly the verbs the engine issues on the wire (pinned by
+tests/test_manifests.py, which records a full rolling upgrade through
+RestClient and asserts every observed verb is granted — an ungranted new
+verb fails the suite, an unused grant is flagged), and the controller
+Deployment.  Rendered to config/manifests/ by ``tools/gen_manifests.py``
+(drift-checked in CI via ``make generate-check``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from k8s_operator_libs_tpu.api.schema import POLICY_GROUP, POLICY_PLURAL
+
+CONTROLLER_NAME = "tpu-upgrade-controller"
+# Shared by the driver pods (safe-load init container sets/polls its node
+# annotation) and the health-agent pods (publish report annotations):
+# both only ever get/patch their own Node.  DriverDaemonSetSpec defaults
+# its pods onto this ServiceAccount.
+NODE_REPORTER_NAME = "tpu-node-reporter"
+DEFAULT_IMAGE = "tpu-operator-libs:latest"
+
+# The controller's API surface.  Every (group, resource, verb) the engine
+# can issue; see RestClient methods and _stat_key kinds.
+CONTROLLER_RBAC_RULES: list[dict[str, Any]] = [
+    # BuildState reads + cordon/uncordon + state-label/annotation writes.
+    {"apiGroups": [""], "resources": ["nodes"], "verbs": ["get", "list", "patch"]},
+    # Pod snapshots, wait-for-jobs checks, driver-pod restarts.
+    {"apiGroups": [""], "resources": ["pods"], "verbs": ["get", "list", "delete"]},
+    # Drain + workload eviction go through the Eviction subresource.
+    {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
+    # Driver/agent DaemonSet reconciliation.
+    {
+        "apiGroups": ["apps"],
+        "resources": ["daemonsets"],
+        "verbs": ["get", "list", "create", "update"],
+    },
+    # The outdated-pod detector reads ControllerRevisions.
+    {
+        "apiGroups": ["apps"],
+        "resources": ["controllerrevisions"],
+        "verbs": ["get", "list"],
+    },
+    # Policy-as-CR mode: read the spec, publish counters to status.
+    {
+        "apiGroups": [POLICY_GROUP],
+        "resources": [POLICY_PLURAL],
+        "verbs": ["get", "list"],
+    },
+    {
+        "apiGroups": [POLICY_GROUP],
+        "resources": [f"{POLICY_PLURAL}/status"],
+        "verbs": ["update"],
+    },
+]
+
+# Driver safe-load init containers and per-host agents only read their
+# own Node and patch annotations on it.
+NODE_REPORTER_RBAC_RULES: list[dict[str, Any]] = [
+    {"apiGroups": [""], "resources": ["nodes"], "verbs": ["get", "patch"]},
+]
+
+
+def _service_account(name: str, namespace: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": name, "namespace": namespace},
+    }
+
+
+def _cluster_role(name: str, rules: list[dict]) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": name},
+        "rules": rules,
+    }
+
+
+def _cluster_role_binding(name: str, sa: str, namespace: str) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": name},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": name,
+        },
+        "subjects": [
+            {
+                "kind": "ServiceAccount",
+                "name": sa,
+                "namespace": namespace,
+            }
+        ],
+    }
+
+
+def controller_deployment(
+    namespace: str,
+    image: str,
+    policy_cr: Optional[str] = None,
+) -> dict:
+    """Single-replica controller Deployment.  One replica is correct, not
+    a limitation: all state lives in cluster labels, passes are
+    idempotent, and two concurrent controllers would only race benignly
+    (chaos tier), but a second replica buys nothing."""
+    args = [
+        "--namespace",
+        namespace,
+        "--manage-daemonset",
+        "--manage-agent",
+        "--metrics-port",
+        "8081",
+    ]
+    if policy_cr:
+        args += ["--policy-cr", policy_cr]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": CONTROLLER_NAME,
+            "namespace": namespace,
+            "labels": {"app": CONTROLLER_NAME},
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": CONTROLLER_NAME}},
+            "template": {
+                "metadata": {"labels": {"app": CONTROLLER_NAME}},
+                "spec": {
+                    "serviceAccountName": CONTROLLER_NAME,
+                    "containers": [
+                        {
+                            "name": "controller",
+                            "image": image,
+                            "command": [
+                                "python",
+                                "-m",
+                                "k8s_operator_libs_tpu.controller",
+                            ],
+                            "args": args,
+                            "ports": [
+                                {"name": "metrics", "containerPort": 8081}
+                            ],
+                            "resources": {
+                                "requests": {
+                                    "cpu": "100m",
+                                    "memory": "256Mi",
+                                },
+                                "limits": {"memory": "1Gi"},
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def controller_manifests(
+    namespace: str = "kube-system",
+    image: str = DEFAULT_IMAGE,
+    policy_cr: Optional[str] = None,
+) -> list[dict]:
+    """Everything `kubectl apply` needs besides the CRD (config/crd/)."""
+    return [
+        _service_account(CONTROLLER_NAME, namespace),
+        _cluster_role(CONTROLLER_NAME, CONTROLLER_RBAC_RULES),
+        _cluster_role_binding(CONTROLLER_NAME, CONTROLLER_NAME, namespace),
+        _service_account(NODE_REPORTER_NAME, namespace),
+        _cluster_role(NODE_REPORTER_NAME, NODE_REPORTER_RBAC_RULES),
+        _cluster_role_binding(
+            NODE_REPORTER_NAME, NODE_REPORTER_NAME, namespace
+        ),
+        controller_deployment(namespace, image, policy_cr),
+    ]
+
+
+# -- verb-coverage helpers (used by tests and gen tooling) -------------------
+
+# RestClient._stat_key kind -> (apiGroup, resource).
+_KIND_TO_RESOURCE = {
+    "nodes": ("", "nodes"),
+    "pods": ("", "pods"),
+    "eviction": ("", "pods/eviction"),
+    "daemonsets": ("apps", "daemonsets"),
+    "controllerrevisions": ("apps", "controllerrevisions"),
+    POLICY_PLURAL: (POLICY_GROUP, POLICY_PLURAL),
+    f"{POLICY_PLURAL}/status": (POLICY_GROUP, f"{POLICY_PLURAL}/status"),
+}
+
+_METHOD_TO_VERBS = {
+    # A GET is a get or a list; RBAC needs whichever was used — we map to
+    # both alternatives and accept either grant.
+    "GET": ("get", "list"),
+    "PATCH": ("patch",),
+    "DELETE": ("delete",),
+    "POST": ("create",),
+    "PUT": ("update",),
+}
+
+
+def required_grants(stat_keys) -> set[tuple[str, str, tuple[str, ...]]]:
+    """Map RestClient.stats keys ("GET nodes") to (group, resource,
+    acceptable-verbs) requirements."""
+    out = set()
+    for key in stat_keys:
+        method, _, kind = key.partition(" ")
+        resource = _KIND_TO_RESOURCE.get(kind)
+        verbs = _METHOD_TO_VERBS.get(method)
+        if resource is None or verbs is None:
+            raise ValueError(f"unmapped stat key {key!r}")
+        out.add((resource[0], resource[1], verbs))
+    return out
+
+
+def rule_grants(rules: list[dict]) -> set[tuple[str, str, str]]:
+    return {
+        (group, resource, verb)
+        for rule in rules
+        for group in rule["apiGroups"]
+        for resource in rule["resources"]
+        for verb in rule["verbs"]
+    }
+
+
+def uncovered(stat_keys, rules: list[dict]) -> list[str]:
+    """Requirements from observed traffic that no rule grants."""
+    granted = rule_grants(rules)
+    missing = []
+    for group, resource, verbs in sorted(required_grants(stat_keys)):
+        if not any((group, resource, v) in granted for v in verbs):
+            missing.append(f"{group or 'core'}/{resource}: needs one of {verbs}")
+    return missing
