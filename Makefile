@@ -36,10 +36,10 @@ test-fast:
 # names, bare excepts, mutable defaults) is the hard gate and always
 # runs; ruff adds broader checks when installed.  No silent fallback.
 lint:
-	$(PYTHON) tools/lint.py k8s_operator_libs_tpu tests tools bench.py \
-		__graft_entry__.py
+	$(PYTHON) tools/lint.py k8s_operator_libs_tpu tests tools examples \
+		bench.py __graft_entry__.py
 	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
-		$(PYTHON) -m ruff check k8s_operator_libs_tpu tests tools; \
+		$(PYTHON) -m ruff check k8s_operator_libs_tpu tests tools examples; \
 	fi
 
 # Line coverage via the in-repo sys.monitoring runner; fails the build

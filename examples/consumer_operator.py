@@ -1,0 +1,115 @@
+"""A consumer operator embedding the upgrade library — the reference's
+primary usage shape (its consumers, the GPU/Network Operators, own the
+reconcile loop and wire their own policy source and validation).
+
+This example manages a fictional "mydriver" DaemonSet with:
+
+- its own policy source (here: a dict; in a real operator, your CRD),
+- a custom validation prober (here: "driver pod publishes a ready file
+  marker annotation" — the moral equivalent of the reference's consumers
+  pointing ValidationManager at their nvidia-smi validation pod),
+- its own reconcile cadence.
+
+Run against a real cluster (kubeconfig from $KUBECONFIG or
+~/.kube/config, in-cluster service account when deployed):
+
+    python examples/consumer_operator.py --interval 30
+
+or exercise it hermetically (what tests/test_example.py does) by passing
+a FakeCluster through ``run_reconcile_loop(client, ...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from k8s_operator_libs_tpu.api import TPUUpgradePolicySpec
+from k8s_operator_libs_tpu.health.slice_prober import ProbeResult
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+)
+
+DRIVER_NAME = "mydriver"
+NAMESPACE = "mydriver-system"
+DRIVER_LABELS = {"app": f"{DRIVER_NAME}-driver"}
+READY_MARKER = "example.com/mydriver-validated"
+
+
+class MarkerProber:
+    """Consumer-supplied validation: a slice passes when every host's
+    node carries the READY_MARKER annotation (your driver's readiness
+    probe would publish it).  Same duck type as NodeReportProber."""
+
+    def probe(self, group) -> ProbeResult:
+        missing = [
+            n.name
+            for n in group.nodes
+            if n.annotations.get(READY_MARKER) != "true"
+        ]
+        if missing:
+            return ProbeResult(
+                False, f"awaiting validation marker on: {', '.join(missing)}"
+            )
+        return ProbeResult(True, f"all {group.size()} host(s) validated")
+
+
+def build_manager(client) -> ClusterUpgradeStateManager:
+    keys = UpgradeKeys(driver_name=DRIVER_NAME, domain="example.com")
+    mgr = ClusterUpgradeStateManager(client, keys=keys)
+    mgr.with_validation_enabled(MarkerProber())
+    # Your workload pods, not DaemonSets, get evicted before the upgrade.
+    mgr.with_pod_deletion_enabled(lambda pod: not pod.is_daemonset_pod())
+    return mgr
+
+
+def load_policy() -> TPUUpgradePolicySpec:
+    """In a real operator this comes from your CRD spec."""
+    return TPUUpgradePolicySpec.from_dict(
+        {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 1,
+            "maxUnavailable": "25%",
+            "podDeletion": {"force": False, "timeoutSeconds": 300},
+            "drain": {"enable": True, "timeoutSeconds": 300},
+            # The library's TPU health gate is replaced by MarkerProber,
+            # so the built-in gate knobs are left enabled-by-default.
+        }
+    )
+
+
+def run_reconcile_loop(
+    client, interval_s: float = 30.0, max_passes: int | None = None
+) -> None:
+    """The consumer-owned loop: snapshot, tick, sleep — identical shape
+    to a controller-runtime Reconcile with a resync period."""
+    mgr = build_manager(client)
+    policy = load_policy()
+    passes = 0
+    while max_passes is None or passes < max_passes:
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work()
+        print(
+            f"pass {passes}: managed={mgr.get_total_managed_nodes(state)} "
+            f"in-progress={mgr.get_upgrades_in_progress(state)} "
+            f"done={mgr.get_upgrades_done(state)} "
+            f"failed={mgr.get_upgrades_failed(state)}"
+        )
+        passes += 1
+        if max_passes is None:
+            time.sleep(interval_s)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--interval", type=float, default=30.0)
+    args = parser.parse_args()
+    from k8s_operator_libs_tpu.k8s import get_default_client
+
+    run_reconcile_loop(get_default_client(), interval_s=args.interval)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,15 +51,23 @@ class ClusterFixture:
     # -- daemonsets ----------------------------------------------------------
 
     def daemon_set(
-        self, name: str = "libtpu", hash_suffix: str = "hash-1", revision: int = 1
+        self,
+        name: str = "libtpu",
+        hash_suffix: str = "hash-1",
+        revision: int = 1,
+        labels: Optional[dict] = None,
+        namespace: Optional[str] = None,
     ) -> DaemonSet:
+        labels = dict(labels if labels is not None else DRIVER_LABELS)
         ds = DaemonSet(
             metadata=ObjectMeta(
-                name=name, namespace=self.namespace, labels=dict(DRIVER_LABELS)
+                name=name,
+                namespace=namespace or self.namespace,
+                labels=dict(labels),
             ),
             spec=DaemonSetSpec(
-                selector=LabelSelectorSpec(dict(DRIVER_LABELS)),
-                template=PodTemplateSpec(labels=dict(DRIVER_LABELS)),
+                selector=LabelSelectorSpec(dict(labels)),
+                template=PodTemplateSpec(labels=dict(labels)),
             ),
             status=DaemonSetStatus(desired_number_scheduled=0),
         )
@@ -163,12 +171,15 @@ class ClusterFixture:
     ) -> Pod:
         """Driver pod owned by the DaemonSet (or orphaned if ds is None),
         carrying the controller-revision-hash label the outdated-detector
-        compares (pod_manager.go:87-92)."""
-        labels = dict(DRIVER_LABELS)
+        compares (pod_manager.go:87-92).  Pod labels follow the owning
+        DaemonSet's selector (custom consumer labels included)."""
+        labels = dict(
+            ds.spec.selector.match_labels if ds is not None else DRIVER_LABELS
+        )
         labels["controller-revision-hash"] = hash_suffix
         meta = ObjectMeta(
             name=name or f"driver-{node.name}",
-            namespace=self.namespace,
+            namespace=ds.namespace if ds is not None else self.namespace,
             labels=labels,
         )
         if ds is not None:
@@ -228,13 +239,14 @@ class ClusterFixture:
         it from the current template (new revision hash)."""
 
         def hook(pod: Pod) -> None:
-            if pod.labels.get("app") != DRIVER_LABELS["app"]:
+            selector = ds.spec.selector.match_labels
+            if not all(pod.labels.get(k) == v for k, v in selector.items()):
                 return
             if not pod.metadata.owner_references:
                 return
             if pod.metadata.owner_references[0].uid != ds.metadata.uid:
                 return
-            labels = dict(DRIVER_LABELS)
+            labels = dict(selector)
             labels["controller-revision-hash"] = hash_suffix
             new_pod = Pod(
                 metadata=ObjectMeta(
