@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import signal
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -83,6 +84,14 @@ class ControllerConfig:
     # CRD").  The controller also writes upgrade counters back to the
     # CR's status subresource.
     policy_ref: Optional[tuple[str, str]] = None
+    # Event-driven reconcile (controller-runtime informer semantics): a
+    # watch on nodes/pods/daemonsets (+ the policy CR when policy_ref is
+    # set) triggers a pass immediately instead of waiting out interval_s,
+    # which becomes the periodic-resync fallback.  Mid-roll this makes
+    # progress latency event-bound, not interval-bound.
+    watch: bool = False
+    # Coalesce bursts of watch events into one pass.
+    watch_debounce_s: float = 0.1
 
 
 class UpgradeController:
@@ -131,6 +140,9 @@ class UpgradeController:
         # status write) and whether "missing" was already logged.
         self._policy_cr: Optional[dict] = None
         self._policy_cr_missing = False
+        # Set while run_forever is in watch mode so stop() can interrupt
+        # a long resync wait.
+        self._wake: Optional[threading.Event] = None
 
     def reconcile_once(self) -> bool:
         """One full pass; returns False when the snapshot was incoherent
@@ -280,24 +292,75 @@ class UpgradeController:
 
     def stop(self, *_args) -> None:
         self._stop = True
+        if self._wake is not None:
+            self._wake.set()  # interrupt a watch-mode resync wait
+
+    def _watch_kinds(self) -> list[str]:
+        kinds = ["Node", "Pod", "DaemonSet"]
+        if self.config.policy_ref is not None:
+            from k8s_operator_libs_tpu.api.schema import (
+                POLICY_GROUP,
+                POLICY_PLURAL,
+                POLICY_VERSION,
+            )
+
+            ns, _ = self.config.policy_ref
+            kinds.append(
+                f"{POLICY_GROUP}/{POLICY_VERSION}/{ns}/{POLICY_PLURAL}"
+            )
+        return kinds
+
+    def _watch_pump(self, wake: threading.Event) -> None:
+        """Background thread: any watch event sets the wake flag; the
+        stream is re-established on errors (apiserver restarts)."""
+        while not self._stop:
+            try:
+                for ev in self.client.watch_events(self._watch_kinds()):
+                    if self._stop:
+                        return
+                    if ev is not None:
+                        wake.set()
+            except Exception as e:  # noqa: BLE001 — reconnect, don't die
+                logger.warning("watch stream broke (%s); reconnecting", e)
+                time.sleep(1.0)
 
     def run_forever(self) -> None:
         server = None
         if self.config.metrics_port is not None:
             server = MetricsServer(self.registry, self.config.metrics_port)
             server.start()
+        wake: Optional[threading.Event] = None
+        if self.config.watch:
+            wake = threading.Event()
+            self._wake = wake
+            threading.Thread(
+                target=self._watch_pump, args=(wake,), daemon=True
+            ).start()
         logger.info(
-            "upgrade controller started: ns=%s selector=%s interval=%.0fs",
+            "upgrade controller started: ns=%s selector=%s interval=%.0fs "
+            "watch=%s",
             self.config.namespace,
             self.config.driver_labels,
             self.config.interval_s,
+            self.config.watch,
         )
         try:
             while not self._stop:
+                if wake is not None:
+                    # Clear BEFORE reconciling: an event that lands
+                    # mid-pass must trigger another pass, not be lost.
+                    wake.clear()
                 try:
                     self.reconcile_once()
                 except Exception:  # noqa: BLE001 — loop must survive
                     logger.exception("reconcile pass failed")
+                if wake is not None:
+                    # Event-driven: wake on the first change, or resync
+                    # after the full interval.
+                    woken = wake.wait(self.config.interval_s)
+                    if woken and self.config.watch_debounce_s > 0:
+                        time.sleep(self.config.watch_debounce_s)
+                    continue
                 deadline = time.monotonic() + self.config.interval_s
                 while not self._stop and time.monotonic() < deadline:
                     time.sleep(0.2)
@@ -377,6 +440,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         "(requires config/crd/ installed) instead of --policy-file; "
         "upgrade counters are written back to the CR status",
     )
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="event-driven reconcile: watch nodes/pods/daemonsets (and "
+        "the policy CR) and reconcile on change; --interval becomes the "
+        "periodic-resync fallback",
+    )
     args = parser.parse_args(argv)
     if args.policy_cr and args.policy_file:
         parser.error("--policy-cr and --policy-file are mutually exclusive")
@@ -421,6 +491,7 @@ def main(argv: Optional[list[str]] = None) -> None:
             agent_spec=agent_spec,
             metrics_port=args.metrics_port,
             policy_ref=policy_ref,
+            watch=args.watch,
         ),
     )
     signal.signal(signal.SIGTERM, controller.stop)

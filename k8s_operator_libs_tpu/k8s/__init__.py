@@ -31,6 +31,7 @@ from k8s_operator_libs_tpu.k8s.client import (  # noqa: F401
     FakeCluster,
     InvalidError,
     NotFoundError,
+    WatchEvent,
 )
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper, DrainError  # noqa: F401
 from k8s_operator_libs_tpu.k8s.rest import (  # noqa: F401

@@ -197,6 +197,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # Set by KubeApiServer.
     store: FakeCluster = None  # type: ignore[assignment]
+    stopping: threading.Event = None  # type: ignore[assignment]
 
     def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib logging
         logger.debug("apiserver: " + fmt, *args)
@@ -265,9 +266,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
         label_selector = query.get("labelSelector", "")
+        watching = query.get("watch") == "true"
         # /api/v1/nodes[/{name}]
         if parts[:2] == ["api", "v1"] and len(parts) >= 3 and parts[2] == "nodes":
             if len(parts) == 3:
+                if method == "GET" and watching:
+                    return self._stream_watch(
+                        ["Node"], node_to_json, label_selector=label_selector
+                    )
                 if method == "GET":
                     items = self.store.list_nodes(
                         label_selector=label_selector
@@ -291,10 +297,21 @@ class _Handler(BaseHTTPRequestHandler):
         # /api/v1/pods and /api/v1/namespaces/{ns}/pods[/{name}[/eviction]]
         if parts[:2] == ["api", "v1"]:
             if parts[2:] == ["pods"] and method == "GET":
+                if watching:
+                    return self._stream_watch(
+                        ["Pod"], pod_to_json, label_selector=label_selector
+                    )
                 return self._list_pods("", query)
             if len(parts) >= 5 and parts[2] == "namespaces" and parts[4] == "pods":
                 ns = parts[3]
                 if len(parts) == 5:
+                    if method == "GET" and watching:
+                        return self._stream_watch(
+                            ["Pod"],
+                            pod_to_json,
+                            namespace=ns,
+                            label_selector=label_selector,
+                        )
                     if method == "GET":
                         return self._list_pods(ns, query)
                     return self._method_not_allowed(method, parts)
@@ -324,6 +341,13 @@ class _Handler(BaseHTTPRequestHandler):
             if rest_parts[:1] == ["namespaces"]:
                 ns = rest_parts[1]
                 rest_parts = rest_parts[2:]
+            if rest_parts == ["daemonsets"] and method == "GET" and watching:
+                return self._stream_watch(
+                    ["DaemonSet"],
+                    daemon_set_to_json_full,
+                    namespace=ns,
+                    label_selector=label_selector,
+                )
             if rest_parts[:1] == ["daemonsets"]:
                 return self._daemonsets(method, ns, rest_parts[1:], query)
             if rest_parts[:1] == ["controllerrevisions"] and method == "GET":
@@ -346,12 +370,82 @@ class _Handler(BaseHTTPRequestHandler):
             group, version, ns = parts[1], parts[2], parts[4]
             plural = parts[5]
             name = parts[6] if len(parts) >= 7 else None
+            if name is None and method == "GET" and watching:
+                # Validate the CRD is registered before streaming.
+                self.store._custom_kind(group, version, plural)
+                return self._stream_watch(
+                    [plural], lambda obj: obj, namespace=ns
+                )
             status_sub = len(parts) == 8 and parts[7] == "status"
             if len(parts) <= 7 or status_sub:
                 return self._custom_objects(
                     method, group, version, plural, ns, name, status_sub
                 )
         raise NotFoundError(f"no route for {method} {'/'.join(parts)}")
+
+    # -- watch streaming ----------------------------------------------------
+
+    @staticmethod
+    def _event_meta(obj) -> tuple[str, dict]:
+        """(namespace, labels) of a watch-event object, typed or dict."""
+        if isinstance(obj, dict):
+            meta = obj.get("metadata") or {}
+            return meta.get("namespace", ""), meta.get("labels") or {}
+        return obj.metadata.namespace or "", obj.metadata.labels
+
+    def _stream_watch(
+        self,
+        kinds: list[str],
+        to_json,
+        namespace: str = "",
+        label_selector: str = "",
+    ) -> None:
+        """Stream watch events as chunked JSON lines until the client
+        goes away, in the real apiserver's envelope shape
+        ``{"type": ..., "object": {...}}`` (the object carries its own
+        kind), scoped by the request's namespace/labelSelector.  Blank
+        lines are heartbeats (clients skip them); there is no replay of
+        pre-subscription events — clients pair watches with periodic
+        resync, like controller-runtime informers."""
+        sub = self.store.watch(kinds)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while not self.stopping.is_set():
+                ev = sub.get(timeout_s=0.5)
+                if ev is None:
+                    self._write_chunk(b"\n")  # heartbeat / liveness probe
+                    continue
+                ns, labels = self._event_meta(ev.object)
+                if namespace and ns and ns != namespace:
+                    continue
+                if label_selector and not matches_selector(
+                    labels, label_selector
+                ):
+                    continue
+                line = (
+                    json.dumps(
+                        {"type": ev.type, "object": to_json(ev.object)}
+                    ).encode()
+                    + b"\n"
+                )
+                self._write_chunk(line)
+            # Server stopping: end the chunked body properly so the
+            # client observes a CLEAN stream close (and reconnects),
+            # exactly like a real apiserver's watch request timeout.
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client hung up
+        finally:
+            sub.close()
+            self.close_connection = True
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
 
     def _custom_objects(
         self,
@@ -540,7 +634,12 @@ class KubeApiServer:
 
     def __init__(self, store: Optional[FakeCluster] = None, port: int = 0):
         self.store = store if store is not None else FakeCluster()
-        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self._stopping = threading.Event()
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"store": self.store, "stopping": self._stopping},
+        )
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -560,6 +659,9 @@ class KubeApiServer:
         return self
 
     def stop(self) -> None:
+        # Terminate open watch streams first (their handler threads
+        # outlive shutdown(), which only stops the accept loop).
+        self._stopping.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

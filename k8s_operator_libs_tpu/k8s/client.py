@@ -25,11 +25,13 @@ against envtest.
 from __future__ import annotations
 
 import copy
+import queue
 import threading
 import time
 import uuid
 from collections import Counter, defaultdict
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
 
 from k8s_operator_libs_tpu.k8s.objects import (
     ControllerRevision,
@@ -85,25 +87,70 @@ class InvalidError(ValueError):
 _HISTORY_CAP = 64
 
 
-class _Store:
-    """One kind's storage with per-key write history for cache-lag reads."""
+@dataclass
+class WatchEvent:
+    """One change notification: ADDED | MODIFIED | DELETED + a snapshot
+    of the object at mutation time (typed object for built-in kinds)."""
 
-    def __init__(self) -> None:
+    type: str
+    kind: str
+    object: object
+
+
+class WatchSubscription:
+    """Handle for one watch: iterate/get events, close to unsubscribe."""
+
+    def __init__(self, cluster: "FakeCluster", entry) -> None:
+        self._cluster = cluster
+        self._entry = entry
+        self._queue: queue.Queue = entry[1]
+
+    def get(self, timeout_s: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event, or None on timeout."""
+        try:
+            return self._queue.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._cluster._unwatch(self._entry)
+
+    def __enter__(self) -> "WatchSubscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _Store:
+    """One kind's storage with per-key write history for cache-lag reads
+    and an optional change callback (the watch feed)."""
+
+    def __init__(self, on_change=None) -> None:
         self.objs: dict = {}
         # key -> [(monotonic_ts, snapshot-or-None)]; None = deleted
         self.history: dict = defaultdict(list)
+        # Called as on_change(event_type, snapshot) with "ADDED" |
+        # "MODIFIED" | "DELETED" after every mutation.
+        self.on_change = on_change
 
     def put(self, key, obj) -> None:
+        event = "MODIFIED" if key in self.objs else "ADDED"
         obj.metadata.resource_version += 1
         self.objs[key] = obj
         h = self.history[key]
-        h.append((time.monotonic(), deep_copy(obj)))
+        snap = deep_copy(obj)
+        h.append((time.monotonic(), snap))
         if len(h) > _HISTORY_CAP:
             del h[: len(h) - _HISTORY_CAP]
+        if self.on_change is not None:
+            self.on_change(event, snap)
 
     def delete(self, key) -> None:
-        self.objs.pop(key, None)
+        gone = self.objs.pop(key, None)
         self.history[key].append((time.monotonic(), None))
+        if gone is not None and self.on_change is not None:
+            self.on_change("DELETED", deep_copy(gone))
 
     def get_live(self, key):
         return self.objs.get(key)
@@ -127,10 +174,12 @@ class FakeCluster:
 
     def __init__(self, api_latency_s: float = 0.0, cache_lag_s: float = 0.0):
         self._lock = threading.RLock()
-        self._nodes = _Store()
-        self._pods = _Store()
-        self._daemon_sets = _Store()
-        self._revisions = _Store()
+        self._nodes = _Store(self._make_notifier("Node"))
+        self._pods = _Store(self._make_notifier("Pod"))
+        self._daemon_sets = _Store(self._make_notifier("DaemonSet"))
+        self._revisions = _Store(self._make_notifier("ControllerRevision"))
+        # Active watch subscriptions: list of (kinds-or-None, Queue).
+        self._watchers: list[tuple[Optional[set], "queue.Queue"]] = []
         self.api_latency_s = api_latency_s
         self.cache_lag_s = cache_lag_s
         # verb -> count; exposed for bench round-trip accounting
@@ -151,6 +200,58 @@ class FakeCluster:
         self.fault_injector: Optional[Callable[[str], None]] = None
 
     # -- plumbing ----------------------------------------------------------
+
+    def _notify(self, kind: str, event_type: str, snapshot) -> None:
+        for kinds, q in list(self._watchers):
+            if kinds is None or kind in kinds:
+                # Fresh copy per delivery: a consumer mutating its event
+                # must not corrupt the cache-lag history snapshot or
+                # other subscribers' views.
+                q.put(WatchEvent(event_type, kind, copy.deepcopy(snapshot)))
+
+    def _make_notifier(self, kind: str):
+        def notify(event_type: str, snapshot) -> None:
+            self._notify(kind, event_type, snapshot)
+
+        return notify
+
+    def watch(self, kinds: Optional[Sequence[str]] = None) -> "WatchSubscription":
+        """Subscribe to object changes (the informer/watch analogue).
+
+        ``kinds`` filters by kind name ("Node", "Pod", "DaemonSet",
+        "ControllerRevision"); None = all.  Events carry a snapshot of
+        the object at mutation time.  Close the subscription (or use it
+        as a context manager) to unsubscribe."""
+        q: "queue.Queue" = queue.Queue()
+        entry = (set(kinds) if kinds is not None else None, q)
+        with self._lock:
+            self._watchers.append(entry)
+        return WatchSubscription(self, entry)
+
+    def _unwatch(self, entry) -> None:
+        with self._lock:
+            if entry in self._watchers:
+                self._watchers.remove(entry)
+
+    def watch_events(self, kinds: Optional[Sequence[str]] = None):
+        """Generator form of :meth:`watch`, yielding WatchEvents with
+        periodic ``None`` heartbeats (so a consumer can check its stop
+        flag while idle).  Same duck type as RestClient.watch_events —
+        including custom-resource kinds given as
+        "group/version/namespace/plural" (normalized to the plural,
+        which is how CR watch events are keyed).
+
+        Note (informer semantics): there is no replay — events before
+        the subscription are not delivered.  Consumers pair this with a
+        periodic full resync, exactly like controller-runtime."""
+        if kinds is not None:
+            kinds = [k.split("/")[-1] if "/" in k else k for k in kinds]
+        sub = self.watch(kinds)
+        try:
+            while True:
+                yield sub.get(timeout_s=0.5)
+        finally:
+            sub.close()
 
     def _call(self, verb: str) -> None:
         self.stats[verb] += 1
@@ -479,6 +580,8 @@ class FakeCluster:
             meta["uid"] = f"uid-{uuid.uuid4().hex[:12]}"
             meta["resourceVersion"] = "1"
             self._custom[key] = stored
+            # Watch feed keys custom resources by their plural.
+            self._notify(plural, "ADDED", copy.deepcopy(stored))
             return copy.deepcopy(stored)
 
     def get_custom_object(
@@ -534,6 +637,7 @@ class FakeCluster:
         meta["uid"] = current["metadata"]["uid"]
         meta["resourceVersion"] = str(int(cur_rv) + 1)
         self._custom[key] = stored
+        self._notify(plural, "MODIFIED", copy.deepcopy(stored))
         return copy.deepcopy(stored)
 
     def update_custom_object(
@@ -569,7 +673,8 @@ class FakeCluster:
             key = self._custom_kind(group, version, plural) + (namespace, name)
             if key not in self._custom:
                 raise NotFoundError(f"{plural} {namespace}/{name} not found")
-            del self._custom[key]
+            gone = self._custom.pop(key)
+            self._notify(plural, "DELETED", copy.deepcopy(gone))
 
     def list_custom_objects(
         self, group: str, version: str, plural: str, namespace: str = ""

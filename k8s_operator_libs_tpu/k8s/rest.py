@@ -22,6 +22,7 @@ import datetime
 import http.client
 import json
 import os
+import queue
 import ssl
 import tempfile
 import threading
@@ -29,7 +30,7 @@ import time
 import urllib.parse
 from collections import Counter
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Sequence
 
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import (
@@ -38,6 +39,7 @@ from k8s_operator_libs_tpu.k8s.client import (
     InvalidError,
     NotFoundError,
     ThrottledError,
+    WatchEvent,
 )
 from k8s_operator_libs_tpu.k8s.objects import (
     ContainerStatus,
@@ -349,6 +351,15 @@ def controller_revision_from_json(d: dict) -> ControllerRevision:
     )
 
 
+# Wire kind -> parser for typed watch objects (custom resources stay
+# dicts on the wire and through watch_events).
+_WATCH_PARSERS = {
+    "Node": node_from_json,
+    "Pod": pod_from_json,
+    "DaemonSet": daemon_set_from_json,
+}
+
+
 def _label_selector(
     label_selector: str = "", match_labels: Optional[dict[str, str]] = None
 ) -> str:
@@ -428,15 +439,21 @@ class RestClient:
         with self._pool_lock:
             if self._pool:
                 return self._pool.pop()
+        return self._new_connection(self.timeout_s)
+
+    def _new_connection(
+        self, read_timeout_s: float
+    ) -> http.client.HTTPConnection:
+        """A fresh, unpooled connection (watch streams hold one open)."""
         if self._https:
             return http.client.HTTPSConnection(
                 self._netloc,
                 self._port,
-                timeout=self.timeout_s,
+                timeout=read_timeout_s,
                 context=self._ssl,
             )
         return http.client.HTTPConnection(
-            self._netloc, self._port, timeout=self.timeout_s
+            self._netloc, self._port, timeout=read_timeout_s
         )
 
     def _put_conn(self, conn: http.client.HTTPConnection) -> None:
@@ -798,6 +815,116 @@ class RestClient:
             "GET", self._custom_path(group, version, namespace, plural)
         )
         return out.get("items", [])
+
+    # -- watch --------------------------------------------------------------
+
+    def watch_events(self, kinds: Optional[Sequence[str]] = None):
+        """Generator of WatchEvents from the apiserver's streaming watch,
+        with ``None`` heartbeats while idle (same duck type as
+        FakeCluster.watch_events).  ``kinds``: which watch streams to
+        open; None = nodes + pods + daemonsets.  No pre-subscription
+        replay — pair with periodic resync (controller-runtime informer
+        semantics).  Each watched kind holds one dedicated connection
+        outside the keep-alive pool.
+        """
+        kinds = list(kinds) if kinds is not None else [
+            "Node", "Pod", "DaemonSet",
+        ]
+        paths = {
+            "Node": "/api/v1/nodes",
+            "Pod": "/api/v1/pods",
+            "DaemonSet": "/apis/apps/v1/daemonsets",
+        }
+        events: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+
+        def pump(kind: str) -> None:
+            # The event kind comes from the STREAM IDENTITY, never from
+            # the wire: a real apiserver's watch envelope is
+            # {"type", "object"} with no top-level kind.
+            event_kind = kind
+            path = paths.get(kind)
+            if path is None:
+                # Custom-resource watch: the kind is a full CR path,
+                # "group/version/namespace/plural" (watch events for it
+                # carry the plural as their kind).
+                segs = kind.split("/")
+                if len(segs) != 4:
+                    raise ValueError(
+                        "custom watch kind must be "
+                        f"'group/version/namespace/plural', got {kind!r}"
+                    )
+                group, version, ns, plural = segs
+                path = f"/apis/{group}/{version}/namespaces/{ns}/{plural}"
+                event_kind = plural
+            parser = _WATCH_PARSERS.get(event_kind)
+            conn = self._new_connection(read_timeout_s=2.0)
+            try:
+                headers = {"Accept": JSON}
+                token = self._current_token()
+                if token:
+                    headers["Authorization"] = f"Bearer {token}"
+                conn.request("GET", f"{path}?watch=true", headers=headers)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"watch {path} -> {resp.status}: "
+                        f"{resp.read(512).decode(errors='replace')}"
+                    )
+                while not stop.is_set():
+                    try:
+                        line = resp.readline()
+                    except TimeoutError:
+                        continue  # no heartbeat within read timeout
+                    except OSError:
+                        if stop.is_set():
+                            return
+                        raise
+                    if not line:
+                        # Real apiservers close watch streams routinely
+                        # (request timeouts); the consumer must know so
+                        # it can re-establish — a silent return would
+                        # degrade --watch to pure interval polling.
+                        raise RuntimeError(
+                            f"watch {path}: server closed the stream"
+                        )
+                    line = line.strip()
+                    if not line:
+                        events.put(None)  # heartbeat
+                        continue
+                    d = json.loads(line)
+                    obj = d.get("object")
+                    events.put(
+                        WatchEvent(
+                            d.get("type", ""),
+                            event_kind,
+                            parser(obj) if parser else obj,
+                        )
+                    )
+            except Exception as e:  # noqa: BLE001 — surfaced to consumer
+                if not stop.is_set():
+                    events.put(e)
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=pump, args=(k,), daemon=True)
+            for k in kinds
+        ]
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                try:
+                    item = events.get(timeout=0.5)
+                except queue.Empty:
+                    yield None
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
 
 def get_default_client(timeout_s: float = 30.0) -> RestClient:

@@ -1,0 +1,286 @@
+"""Watch support: the informer analogue.
+
+controller-runtime consumers reconcile on watch events, not on a poll
+(reference SURVEY §1: "a consumer operator's reconcile loop"); this tier
+pins the change feed on both the store and the HTTP wire, and proves the
+controller's --watch mode makes progress event-bound instead of
+interval-bound.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.api.schema import (
+    POLICY_GROUP,
+    POLICY_PLURAL,
+    POLICY_VERSION,
+    register_policy_crd,
+)
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    KubeApiServer,
+    KubeConfig,
+    Node,
+    RestClient,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE, make_node
+
+GVP = (POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL)
+
+
+# -- store tier --------------------------------------------------------------
+
+
+def test_watch_added_modified_deleted():
+    cluster = FakeCluster()
+    with cluster.watch(["Node"]) as sub:
+        cluster.create_node(make_node("n0"))
+        ev = sub.get(timeout_s=2.0)
+        assert (ev.type, ev.kind, ev.object.name) == ("ADDED", "Node", "n0")
+        cluster.patch_node_labels("n0", {"x": "1"})
+        ev = sub.get(timeout_s=2.0)
+        assert ev.type == "MODIFIED"
+        assert ev.object.labels["x"] == "1"
+        # Pod changes are filtered out.
+        fx = ClusterFixture(cluster, UpgradeKeys())
+        fx.workload_pod(make_node("other"), namespace=NAMESPACE)
+        assert sub.get(timeout_s=0.2) is None
+
+
+def test_watch_close_unsubscribes():
+    cluster = FakeCluster()
+    sub = cluster.watch(["Node"])
+    sub.close()
+    cluster.create_node(make_node("n0"))
+    assert sub.get(timeout_s=0.2) is None
+
+
+def test_watch_custom_resources_by_plural():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    with cluster.watch([POLICY_PLURAL]) as sub:
+        cluster.create_custom_object(
+            *GVP,
+            "ns",
+            {"metadata": {"name": "p"}, "spec": {"autoUpgrade": True}},
+        )
+        ev = sub.get(timeout_s=2.0)
+        assert ev.type == "ADDED" and ev.kind == POLICY_PLURAL
+        cr = cluster.get_custom_object(*GVP, "ns", "p")
+        cr["spec"]["autoUpgrade"] = False
+        cluster.update_custom_object(*GVP, "ns", cr)
+        assert sub.get(timeout_s=2.0).type == "MODIFIED"
+        cluster.delete_custom_object(*GVP, "ns", "p")
+        assert sub.get(timeout_s=2.0).type == "DELETED"
+
+
+def test_watch_events_generator_normalizes_cr_form_and_heartbeats():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    gen = cluster.watch_events(
+        [f"{POLICY_GROUP}/{POLICY_VERSION}/ns/{POLICY_PLURAL}"]
+    )
+    try:
+        assert next(gen) is None  # idle heartbeat
+        cluster.create_custom_object(
+            *GVP, "ns", {"metadata": {"name": "p"}, "spec": {}}
+        )
+        for _ in range(5):
+            ev = next(gen)
+            if ev is not None:
+                break
+        assert ev.kind == POLICY_PLURAL and ev.type == "ADDED"
+    finally:
+        gen.close()
+
+
+# -- wire tier ---------------------------------------------------------------
+
+
+def test_watch_over_the_wire_types_objects():
+    store = FakeCluster()
+    register_policy_crd(store)
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        gen = client.watch_events(
+            ["Node", f"{POLICY_GROUP}/{POLICY_VERSION}/ns/{POLICY_PLURAL}"]
+        )
+        try:
+            # Prime the generator (starts its pump threads), then wait
+            # until BOTH streams' server-side subscriptions exist — there
+            # is no replay, so objects must be created after that.
+            assert next(gen) is None
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(store._watchers) < 2:
+                time.sleep(0.02)
+            assert len(store._watchers) == 2
+            store.create_node(make_node("n0"))
+            store.create_custom_object(
+                *GVP, "ns", {"metadata": {"name": "p"}, "spec": {}}
+            )
+            got: dict[str, object] = {}
+            while time.monotonic() < deadline and len(got) < 2:
+                ev = next(gen)
+                if ev is not None:
+                    got[ev.kind] = ev
+            assert set(got) == {"Node", POLICY_PLURAL}, set(got)
+            node_ev = got["Node"]
+            assert isinstance(node_ev.object, Node)  # typed on the wire
+            assert node_ev.object.name == "n0"
+            cr_ev = got[POLICY_PLURAL]
+            assert cr_ev.object["metadata"]["name"] == "p"  # dict-shaped
+        finally:
+            gen.close()
+
+
+def test_watch_unregistered_cr_surfaces_error():
+    store = FakeCluster()
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        gen = client.watch_events(
+            [f"{POLICY_GROUP}/{POLICY_VERSION}/ns/nosuch"]
+        )
+        with pytest.raises(RuntimeError, match="watch .* 404|-> 404"):
+            for _ in range(20):
+                next(gen)
+        gen.close()
+
+
+def test_watch_event_snapshots_are_isolated():
+    """Mutating a received event object must not corrupt the store's
+    cache history or other subscribers' views."""
+    cluster = FakeCluster(cache_lag_s=0.0)
+    with cluster.watch(["Node"]) as a, cluster.watch(["Node"]) as b:
+        cluster.create_node(make_node("n0"))
+        ev_a = a.get(timeout_s=2.0)
+        ev_a.object.labels["corrupted"] = "yes"
+        assert "corrupted" not in b.get(timeout_s=2.0).object.labels
+        assert "corrupted" not in cluster.get_node("n0").labels
+
+
+def test_wire_watch_is_scoped_by_namespace_and_selector():
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(store, keys)
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        conn = client._new_connection(read_timeout_s=2.0)
+        try:
+            conn.request(
+                "GET",
+                "/api/v1/namespaces/ns-a/pods?watch=true",
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            # Wait for the server-side subscription, then create one pod
+            # in-scope and one out of scope.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not store._watchers:
+                time.sleep(0.02)
+            fx.workload_pod(make_node("w1"), name="other", namespace="ns-b")
+            fx.workload_pod(make_node("w2"), name="mine", namespace="ns-a")
+            names = []
+            while time.monotonic() < deadline and not names:
+                line = resp.readline().strip()
+                if line:
+                    d = json.loads(line)
+                    names.append(d["object"]["metadata"]["name"])
+                    # Envelope is real-shaped: no top-level kind.
+                    assert set(d) == {"type", "object"}
+            assert names == ["mine"]
+        finally:
+            conn.close()
+
+
+def test_wire_watch_server_close_surfaces_to_consumer():
+    """A server-closed stream must raise out of watch_events (so the
+    controller's pump reconnects) — not silently go quiet."""
+    store = FakeCluster()
+    server = KubeApiServer(store).start()
+    client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+    gen = client.watch_events(["Node"])
+    assert next(gen) is None  # stream established
+    server.stop()
+    with pytest.raises(Exception, match="closed|Connection|read"):
+        for _ in range(40):
+            next(gen)
+    gen.close()
+
+
+# -- controller tier ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("tier", ["fake", "rest"])
+def test_watch_driven_controller_is_event_bound(tier):
+    """With --watch and a resync interval far longer than the test, the
+    roll must complete driven purely by change events."""
+    import contextlib
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(store, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    server_cm = (
+        KubeApiServer(store) if tier == "rest" else contextlib.nullcontext()
+    )
+    with server_cm as server:
+        client = (
+            RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+            if tier == "rest"
+            else store
+        )
+        controller = UpgradeController(
+            client,
+            ControllerConfig(
+                namespace=NAMESPACE,
+                driver_labels=DRIVER_LABELS,
+                interval_s=120.0,  # resync alone could never finish in time
+                policy=TPUUpgradePolicySpec(
+                    auto_upgrade=True,
+                    drain_spec=DrainSpec(enable=True, timeout_second=5),
+                    health_gate=SliceHealthGateSpec(enable=False),
+                ),
+                watch=True,
+                watch_debounce_s=0.02,
+                hbm_floor_fraction=0.0,
+            ),
+        )
+        controller.manager.provider.poll_interval_s = 0.01
+        controller.manager.provider.poll_timeout_s = 2.0
+        thread = threading.Thread(target=controller.run_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                states = {
+                    n.name: store.get_node(n.name, cached=False).labels.get(
+                        keys.state_label, ""
+                    )
+                    for n in nodes
+                }
+                if all(s == "upgrade-done" for s in states.values()):
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail(f"watch-driven roll too slow: {states}")
+        finally:
+            controller.stop()
+            thread.join(15.0)
