@@ -103,10 +103,21 @@ def test_two_process_agents_publish_slice_wide_reports():
             for i in range(2)
         ]
         outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=240)
+                assert p.returncode == 0, (
+                    f"worker failed:\n{out}\n{err[-2000:]}"
+                )
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            # A hung worker must NOT outlive the test: an orphaned pair
+            # keeps its jax.distributed rendezvous half-open and wedges
+            # every subsequent run of this test on the machine.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate(timeout=10)
     finally:
         server.stop()
 
