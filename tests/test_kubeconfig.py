@@ -1,0 +1,151 @@
+"""KubeConfig loading: in-cluster service-account config and kubeconfig
+parsing — real-cluster-facing paths that otherwise only execute in
+production (client-go's rest.InClusterConfig / clientcmd analogues)."""
+
+from __future__ import annotations
+
+import base64
+import os
+import ssl
+
+import pytest
+import yaml
+
+from k8s_operator_libs_tpu.k8s import rest
+from k8s_operator_libs_tpu.k8s.rest import KubeConfig, RestClient
+
+
+def _write_kubeconfig(tmp_path, name="config", user=None, cluster=None,
+                      current="ctx"):
+    cfg = {
+        "current-context": current,
+        "contexts": [
+            {"name": "ctx", "context": {"cluster": "c1", "user": "u1"}},
+            {"name": "other", "context": {"cluster": "c2", "user": "u1"}},
+        ],
+        "clusters": [
+            {"name": "c1", "cluster": cluster or {"server": "https://one:6443"}},
+            {"name": "c2", "cluster": {"server": "https://two:6443"}},
+        ],
+        "users": [{"name": "u1", "user": user or {"token": "tok-1"}}],
+    }
+    path = tmp_path / name
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def test_token_kubeconfig_current_and_override_context(tmp_path):
+    path = _write_kubeconfig(tmp_path)
+    cfg = KubeConfig.from_kubeconfig(path)
+    assert cfg.host == "https://one:6443"
+    assert cfg.token == "tok-1"
+    cfg2 = KubeConfig.from_kubeconfig(path, context="other")
+    assert cfg2.host == "https://two:6443"
+
+
+def test_kubeconfig_env_path_list_picks_first_existing(tmp_path, monkeypatch):
+    real = _write_kubeconfig(tmp_path)
+    missing = str(tmp_path / "nope")
+    monkeypatch.setenv("KUBECONFIG", os.pathsep.join([missing, real]))
+    cfg = KubeConfig.from_kubeconfig()
+    assert cfg.host == "https://one:6443"
+
+
+def test_kubeconfig_inline_data_materializes_temp_files(tmp_path):
+    ca = base64.b64encode(b"CA PEM").decode()
+    cert = base64.b64encode(b"CERT PEM").decode()
+    key = base64.b64encode(b"KEY PEM").decode()
+    path = _write_kubeconfig(
+        tmp_path,
+        user={"client-certificate-data": cert, "client-key-data": key},
+        cluster={
+            "server": "https://one:6443",
+            "certificate-authority-data": ca,
+        },
+    )
+    cfg = KubeConfig.from_kubeconfig(path)
+    with open(cfg.ca_cert_path, "rb") as f:
+        assert f.read() == b"CA PEM"
+    with open(cfg.client_cert_path, "rb") as f:
+        assert f.read() == b"CERT PEM"
+    with open(cfg.client_key_path, "rb") as f:
+        assert f.read() == b"KEY PEM"
+    # The cleanup helper tolerates double-unlink.
+    rest._unlink_quiet(cfg.ca_cert_path)
+    rest._unlink_quiet(cfg.ca_cert_path)
+    assert not os.path.exists(cfg.ca_cert_path)
+
+
+def test_kubeconfig_rejects_exec_plugin_with_clear_error(tmp_path):
+    path = _write_kubeconfig(
+        tmp_path, user={"exec": {"command": "gke-gcloud-auth-plugin"}}
+    )
+    with pytest.raises(RuntimeError, match="credential plugin"):
+        KubeConfig.from_kubeconfig(path)
+
+
+def test_kubeconfig_unknown_context_errors(tmp_path):
+    path = _write_kubeconfig(tmp_path)
+    with pytest.raises(RuntimeError, match="context 'nope' not found"):
+        KubeConfig.from_kubeconfig(path, context="nope")
+    with pytest.raises(RuntimeError, match="cluster/user not found"):
+        bad = yaml.safe_load(open(path))
+        bad["clusters"] = []
+        p2 = tmp_path / "bad"
+        p2.write_text(yaml.safe_dump(bad))
+        KubeConfig.from_kubeconfig(str(p2))
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    sa = tmp_path / "serviceaccount"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token\n")
+    (sa / "ca.crt").write_text("CA")
+    monkeypatch.setattr(rest, "SERVICE_ACCOUNT_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "6443")
+    cfg = KubeConfig.in_cluster()
+    assert cfg.host == "https://10.0.0.1:6443"
+    assert cfg.token == "sa-token"
+    assert cfg.token_path == str(sa / "token")
+    assert cfg.ca_cert_path == str(sa / "ca.crt")
+
+
+def test_in_cluster_outside_cluster_raises(monkeypatch):
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    with pytest.raises(RuntimeError, match="not running in a cluster"):
+        KubeConfig.in_cluster()
+
+
+def test_token_file_rotation(tmp_path):
+    """Bound SA tokens rotate; the client must re-read the file after the
+    refresh interval (client-go behavior)."""
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-old")
+    client = RestClient(
+        KubeConfig(host="http://127.0.0.1:1", token_path=str(token_file))
+    )
+    assert client._current_token() == "tok-old"
+    token_file.write_text("tok-new")
+    # Still cached inside the refresh window...
+    assert client._current_token() == "tok-old"
+    # ...re-read once the window passes.
+    client._token_read_at -= RestClient.TOKEN_REFRESH_S + 1
+    assert client._current_token() == "tok-new"
+
+
+def test_https_client_builds_tls_context(tmp_path):
+    """insecure-skip-tls-verify must actually disable verification on the
+    built SSL context, and https hosts produce HTTPS connections."""
+    client = RestClient(
+        KubeConfig(host="https://k8s:6443", insecure_skip_tls_verify=True)
+    )
+    assert client._ssl.verify_mode == ssl.CERT_NONE
+    assert client._https
+    conn = client._new_connection(read_timeout_s=1.0)
+    try:
+        import http.client
+
+        assert isinstance(conn, http.client.HTTPSConnection)
+    finally:
+        conn.close()
