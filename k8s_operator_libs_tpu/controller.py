@@ -27,6 +27,7 @@ import argparse
 import signal
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -92,6 +93,10 @@ class ControllerConfig:
     watch: bool = False
     # Coalesce bursts of watch events into one pass.
     watch_debounce_s: float = 0.1
+    # Publish recorded transition/failure events to the cluster as
+    # core/v1 Events (reference parity: every transition is an Event,
+    # visible in `kubectl describe node`).
+    publish_events: bool = True
 
 
 class UpgradeController:
@@ -172,7 +177,18 @@ class UpgradeController:
         duration = time.monotonic() - t0
         self.metrics.observe(self.manager, state, duration)
         self.slice_timer.observe_state(state)
-        for ev in self.events.drain():
+        self._flush_events(state)
+        return True
+
+    def _flush_events(self, state=None) -> None:
+        """Drain recorded events to the log AND, when enabled, to the
+        cluster as core/v1 Events (reference util.go:141-153 via
+        client-go's EventRecorder — `kubectl describe node` shows them).
+        Identical events within one pass aggregate into a count.
+        Publication failures never fail the pass."""
+        drained = self.events.drain()
+        counts: dict[tuple[str, str, str, str], int] = {}
+        for ev in drained:
             logger.info(
                 "event %s %s %s: %s",
                 ev.event_type,
@@ -180,7 +196,49 @@ class UpgradeController:
                 ev.reason,
                 ev.message,
             )
-        return True
+            key = (ev.object_name, ev.event_type, ev.reason, ev.message)
+            counts[key] = counts.get(key, 0) + 1
+        if not self.config.publish_events:
+            return
+        # involvedObject needs the node UID for `kubectl describe node`
+        # to find the event (client-go's Search filters on it).
+        node_uids: dict[str, str] = {}
+        if state is not None:
+            for group in state.all_groups():
+                for n in group.nodes:
+                    node_uids[n.name] = n.metadata.uid
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for (obj, etype, reason, message), count in counts.items():
+            involved: dict = {"name": obj, "apiVersion": "v1"}
+            if obj in node_uids:
+                involved["kind"] = "Node"
+                involved["uid"] = node_uids[obj]
+            else:
+                involved["kind"] = "Pod"  # restart-failure events name pods
+            try:
+                self.client.create_event(
+                    self.config.namespace,
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Event",
+                        # A real apiserver requires a client-supplied
+                        # name (client-go EventRecorder does the same
+                        # object.timestamp scheme).
+                        "metadata": {
+                            "name": f"{obj}.{uuid.uuid4().hex[:12]}"
+                        },
+                        "involvedObject": involved,
+                        "type": etype,
+                        "reason": reason,
+                        "message": message,
+                        "count": count,
+                        "firstTimestamp": now,
+                        "lastTimestamp": now,
+                        "source": {"component": "tpu-upgrade-controller"},
+                    },
+                )
+            except Exception as e:  # noqa: BLE001 — telemetry best-effort
+                logger.debug("event publication failed: %s", e)
 
     def _refresh_policy_from_cr(self) -> None:
         """Re-read the TPUUpgradePolicy CR: a policy edit takes effect on

@@ -296,12 +296,56 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._patch_node(name)
         # /api/v1/pods and /api/v1/namespaces/{ns}/pods[/{name}[/eviction]]
         if parts[:2] == ["api", "v1"]:
+            # /api/v1/events — cluster-wide event list.
+            if parts[2:] == ["events"] and method == "GET":
+                field_selector = query.get("fieldSelector", "")
+                involved = ""
+                for clause in field_selector.split(","):
+                    if clause.startswith("involvedObject.name="):
+                        involved = clause.split("=", 1)[1]
+                return self._send(
+                    200,
+                    {
+                        "apiVersion": "v1",
+                        "kind": "EventList",
+                        "items": self.store.list_events(
+                            involved_name=involved
+                        ),
+                    },
+                )
             if parts[2:] == ["pods"] and method == "GET":
                 if watching:
                     return self._stream_watch(
                         ["Pod"], pod_to_json, label_selector=label_selector
                     )
                 return self._list_pods("", query)
+            # /api/v1/namespaces/{ns}/events
+            if (
+                len(parts) == 5
+                and parts[2] == "namespaces"
+                and parts[4] == "events"
+            ):
+                ns = parts[3]
+                if method == "POST":
+                    created = self.store.create_event(ns, self._read_body())
+                    return self._send(201, created)
+                if method == "GET":
+                    field_selector = query.get("fieldSelector", "")
+                    involved = ""
+                    for clause in field_selector.split(","):
+                        if clause.startswith("involvedObject.name="):
+                            involved = clause.split("=", 1)[1]
+                    return self._send(
+                        200,
+                        {
+                            "apiVersion": "v1",
+                            "kind": "EventList",
+                            "items": self.store.list_events(
+                                namespace=ns, involved_name=involved
+                            ),
+                        },
+                    )
+                return self._method_not_allowed(method, parts)
             if len(parts) >= 5 and parts[2] == "namespaces" and parts[4] == "pods":
                 ns = parts[3]
                 if len(parts) == 5:

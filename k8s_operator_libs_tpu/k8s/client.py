@@ -191,6 +191,8 @@ class FakeCluster:
         ] = {}
         # (group, version, plural, namespace, name) -> raw object dict.
         self._custom: dict[tuple[str, str, str, str, str], dict] = {}
+        # core/v1 Events, append-only with a cap (see create_event).
+        self._events: list[dict] = []
         # (namespace, name) pairs whose eviction a PodDisruptionBudget
         # currently blocks (429 in the real API) — test/bench knob.
         self._eviction_blocked: set[tuple[str, str]] = set()
@@ -516,6 +518,57 @@ class FakeCluster:
                 for r in self._revisions.objs.values()
                 if (not namespace or r.metadata.namespace == namespace)
                 and matches_selector(r.metadata.labels, label_selector)
+            ]
+
+    # -- events --------------------------------------------------------------
+    # Dict-shaped core/v1 Events (reference util.go:141-153 records one
+    # per transition/failure via client-go's EventRecorder; kubectl
+    # describe shows them).  Bounded: a busy controller must not grow
+    # the store without limit — real clusters TTL events similarly.
+
+    _EVENTS_CAP = 2048
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        self._call("create_event")
+        with self._lock:
+            stored = copy.deepcopy(event)
+            meta = stored.setdefault("metadata", {})
+            # Real-apiserver semantics: the CLIENT names the event (or
+            # asks for generateName); auto-filling here would mask a
+            # publisher that real clusters reject 422.
+            if not meta.get("name"):
+                if meta.get("generateName"):
+                    meta["name"] = (
+                        meta["generateName"] + uuid.uuid4().hex[:10]
+                    )
+                else:
+                    raise InvalidError(
+                        "metadata.name (or generateName) is required"
+                    )
+            meta["namespace"] = namespace
+            meta["uid"] = f"uid-{uuid.uuid4().hex[:12]}"
+            self._events.append(stored)
+            if len(self._events) > self._EVENTS_CAP:
+                del self._events[: len(self._events) - self._EVENTS_CAP]
+            return copy.deepcopy(stored)
+
+    def list_events(
+        self, namespace: str = "", involved_name: str = ""
+    ) -> list[dict]:
+        self._call("list_events")
+        with self._lock:
+            return [
+                copy.deepcopy(e)
+                for e in self._events
+                if (
+                    not namespace
+                    or e["metadata"].get("namespace") == namespace
+                )
+                and (
+                    not involved_name
+                    or (e.get("involvedObject") or {}).get("name")
+                    == involved_name
+                )
             ]
 
     # -- custom resources ----------------------------------------------------

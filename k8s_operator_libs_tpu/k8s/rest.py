@@ -478,6 +478,7 @@ class RestClient:
             "daemonsets",
             "pods",
             "nodes",
+            "events",
         ):
             if known in parts:
                 kind = known
@@ -753,6 +754,29 @@ class RestClient:
         return [
             controller_revision_from_json(i) for i in out.get("items", [])
         ]
+
+    # -- events -------------------------------------------------------------
+
+    def create_event(self, namespace: str, event: dict) -> dict:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/events", body=event
+        )
+
+    def list_events(
+        self, namespace: str = "", involved_name: str = ""
+    ) -> list[dict]:
+        query = (
+            {"fieldSelector": f"involvedObject.name={involved_name}"}
+            if involved_name
+            else None
+        )
+        path = (
+            f"/api/v1/namespaces/{namespace}/events"
+            if namespace
+            else "/api/v1/events"  # all namespaces, FakeCluster parity
+        )
+        out = self._request("GET", path, query)
+        return out.get("items", [])
 
     # -- custom resources ---------------------------------------------------
     # Dict-shaped CRUD for CRs (e.g. the TPUUpgradePolicy the generated

@@ -34,6 +34,8 @@ CONTROLLER_RBAC_RULES: list[dict[str, Any]] = [
     {"apiGroups": [""], "resources": ["pods"], "verbs": ["get", "list", "delete"]},
     # Drain + workload eviction go through the Eviction subresource.
     {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
+    # Transition/failure events (kubectl describe node shows them).
+    {"apiGroups": [""], "resources": ["events"], "verbs": ["create"]},
     # Driver/agent DaemonSet reconciliation.
     {
         "apiGroups": ["apps"],
@@ -191,6 +193,7 @@ _KIND_TO_RESOURCE = {
     "nodes": ("", "nodes"),
     "pods": ("", "pods"),
     "eviction": ("", "pods/eviction"),
+    "events": ("", "events"),
     "daemonsets": ("apps", "daemonsets"),
     "controllerrevisions": ("apps", "controllerrevisions"),
     POLICY_PLURAL: (POLICY_GROUP, POLICY_PLURAL),

@@ -1,0 +1,152 @@
+"""Cluster-published Events: the reference records a core/v1 Event on
+every transition/failure (util.go:141-153, via client-go EventRecorder);
+here the controller publishes its recorded events so `kubectl describe
+node` tells the upgrade story on real clusters too."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    KubeApiServer,
+    KubeConfig,
+    RestClient,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+
+def test_store_events_create_list_filter_and_cap():
+    from k8s_operator_libs_tpu.k8s import InvalidError
+
+    cluster = FakeCluster()
+    cluster.create_event(
+        "ns",
+        {
+            "metadata": {"name": "n0.abc"},
+            "involvedObject": {"kind": "Node", "name": "n0"},
+            "type": "Normal",
+            "reason": "Up",
+            "message": "m",
+        },
+    )
+    # generateName works; a nameless event is rejected like a real
+    # apiserver would (422), so publishers can't silently depend on
+    # fake-only server-side naming.
+    gen = cluster.create_event(
+        "ns",
+        {
+            "metadata": {"generateName": "n1."},
+            "involvedObject": {"kind": "Node", "name": "n1"},
+        },
+    )
+    assert gen["metadata"]["name"].startswith("n1.")
+    with pytest.raises(InvalidError, match="name"):
+        cluster.create_event(
+            "ns", {"involvedObject": {"kind": "Node", "name": "n2"}}
+        )
+    assert len(cluster.list_events(namespace="ns")) == 2
+    only = cluster.list_events(namespace="ns", involved_name="n0")
+    assert len(only) == 1 and only[0]["reason"] == "Up"
+    # The store is bounded.
+    for i in range(cluster._EVENTS_CAP + 10):
+        cluster.create_event(
+            "ns",
+            {
+                "metadata": {"name": f"x{i}.e"},
+                "involvedObject": {"name": f"x{i}"},
+            },
+        )
+    assert len(cluster.list_events()) == cluster._EVENTS_CAP
+
+
+def test_events_over_the_wire():
+    store = FakeCluster()
+    with KubeApiServer(store) as server:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        created = client.create_event(
+            "ns",
+            {
+                "metadata": {"name": "n0.w1"},
+                "involvedObject": {"kind": "Node", "name": "n0"},
+                "type": "Warning",
+                "reason": "DrainFailed",
+                "message": "boom",
+            },
+        )
+        assert created["metadata"]["uid"]
+        items = client.list_events("ns", involved_name="n0")
+        assert len(items) == 1 and items[0]["reason"] == "DrainFailed"
+        assert client.list_events("ns", involved_name="other") == []
+        # Cluster-wide list (no namespace) matches FakeCluster semantics.
+        assert len(client.list_events()) == len(store.list_events()) == 1
+
+
+@pytest.mark.parametrize("publish", [True, False])
+def test_controller_publishes_transition_events(publish):
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    controller = UpgradeController(
+        cluster,
+        ControllerConfig(
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            interval_s=0.01,
+            policy=TPUUpgradePolicySpec(
+                auto_upgrade=True,
+                drain_spec=DrainSpec(enable=True, timeout_second=5),
+                health_gate=SliceHealthGateSpec(enable=False),
+            ),
+            publish_events=publish,
+            hbm_floor_fraction=0.0,
+        ),
+    )
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    for _ in range(40):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+        states = {
+            n.name: cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if all(s == "upgrade-done" for s in states.values()):
+            break
+    else:
+        pytest.fail(f"never converged: {states}")
+    controller.reconcile_once()
+
+    events = cluster.list_events(
+        namespace=NAMESPACE, involved_name=nodes[0].name
+    )
+    if not publish:
+        assert events == []
+        return
+    messages = " | ".join(e["message"] for e in events)
+    # The full transition story is on the node.
+    for needle in ("cordon-required", "upgrade-done"):
+        assert needle in messages, messages
+    sample = events[0]
+    assert sample["source"] == {"component": "tpu-upgrade-controller"}
+    assert sample["involvedObject"]["kind"] == "Node"
+    # kubectl-describe findability: client-supplied name + node UID.
+    assert sample["metadata"]["name"].startswith(nodes[0].name + ".")
+    live_uid = cluster.get_node(nodes[0].name, cached=False).metadata.uid
+    assert sample["involvedObject"]["uid"] == live_uid
+    assert sample["count"] >= 1
