@@ -321,6 +321,9 @@ class UpgradeController:
                     state
                 ),
             }
+            status["conditions"] = self._conditions(
+                status, (cr.get("status") or {}).get("conditions") or []
+            )
             if cr.get("status") == status:
                 return  # no churn: don't bump resourceVersion every pass
             cr["status"] = status
@@ -329,6 +332,64 @@ class UpgradeController:
             )
         except (NotFoundError, ConflictError) as e:
             logger.debug("status update skipped: %s", e)
+
+    @staticmethod
+    def _conditions(status: dict, previous: list[dict]) -> list[dict]:
+        """Standard operator status.conditions derived from the counters,
+        with lastTransitionTime preserved while a condition's status is
+        unchanged (k8s meta.v1 Condition semantics)."""
+        in_flight = status["upgradesInProgress"] + status["upgradesPending"]
+        want = [
+            (
+                "Progressing",
+                in_flight > 0,
+                "UpgradesInFlight" if in_flight else "NoPendingUpgrades",
+                f"{status['upgradesInProgress']} in progress, "
+                f"{status['upgradesPending']} pending",
+            ),
+            (
+                "Degraded",
+                status["upgradesFailed"] > 0,
+                "SlicesFailed" if status["upgradesFailed"] else "AllHealthy",
+                f"{status['upgradesFailed']} node(s) in upgrade-failed",
+            ),
+            (
+                "Complete",
+                in_flight == 0 and status["upgradesFailed"] == 0,
+                (
+                    "AllDone"
+                    if in_flight == 0 and status["upgradesFailed"] == 0
+                    else "Failures"
+                    if status["upgradesFailed"]
+                    else "InProgress"
+                ),
+                f"{status['upgradesDone']}/{status['totalManagedNodes']} "
+                "nodes at the current driver",
+            ),
+        ]
+        prev_by_type = {c.get("type"): c for c in previous}
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        out = []
+        for ctype, truthy, reason, message in want:
+            cond_status = "True" if truthy else "False"
+            prev = prev_by_type.get(ctype)
+            last_transition = (
+                prev["lastTransitionTime"]
+                if prev is not None
+                and prev.get("status") == cond_status
+                and prev.get("lastTransitionTime")
+                else now
+            )
+            out.append(
+                {
+                    "type": ctype,
+                    "status": cond_status,
+                    "reason": reason,
+                    "message": message,
+                    "lastTransitionTime": last_transition,
+                }
+            )
+        return out
 
     def _current_driver_revision(self) -> str:
         """Current ControllerRevision hash of the (first) driver

@@ -263,6 +263,51 @@ def test_controller_follows_policy_cr_and_reports_status():
     assert status["totalManagedNodes"] == 2
     assert status["totalManagedGroups"] == 1
     assert status["upgradesInProgress"] == 0
+    # Standard operator conditions derived from the counters.
+    conds = {c["type"]: c for c in status["conditions"]}
+    assert conds["Progressing"]["status"] == "False"
+    assert conds["Degraded"]["status"] == "False"
+    assert conds["Complete"]["status"] == "True"
+    assert conds["Complete"]["reason"] == "AllDone"
+    assert "2/2" in conds["Complete"]["message"]
+def test_conditions_unit_semantics():
+    """Sticky lastTransitionTime + correct reasons, with forged previous
+    timestamps (the e2e path can't distinguish stickiness from
+    1-second clock resolution)."""
+    counters = {
+        "upgradesInProgress": 0,
+        "upgradesPending": 0,
+        "upgradesFailed": 0,
+        "upgradesDone": 4,
+        "totalManagedNodes": 4,
+    }
+    old = "2020-01-01T00:00:00Z"
+    previous = [
+        {"type": "Progressing", "status": "False", "lastTransitionTime": old},
+        {"type": "Degraded", "status": "True", "lastTransitionTime": old},
+        {"type": "Complete", "status": "True", "lastTransitionTime": old},
+    ]
+    conds = {
+        c["type"]: c
+        for c in UpgradeController._conditions(counters, previous)
+    }
+    # Unchanged statuses keep the old transition time...
+    assert conds["Progressing"]["lastTransitionTime"] == old
+    assert conds["Complete"]["lastTransitionTime"] == old
+    # ...a flipped one (Degraded True -> False) gets a fresh stamp.
+    assert conds["Degraded"]["status"] == "False"
+    assert conds["Degraded"]["lastTransitionTime"] != old
+    # Failure reasons are not contradictory: Complete=False must not
+    # claim AllDone.
+    failed = dict(counters, upgradesFailed=2, upgradesDone=2)
+    conds = {c["type"]: c for c in UpgradeController._conditions(failed, [])}
+    assert conds["Complete"]["status"] == "False"
+    assert conds["Complete"]["reason"] == "Failures"
+    assert conds["Degraded"]["status"] == "True"
+    rolling = dict(counters, upgradesInProgress=2, upgradesDone=2)
+    conds = {c["type"]: c for c in UpgradeController._conditions(rolling, [])}
+    assert conds["Complete"]["reason"] == "InProgress"
+    assert conds["Progressing"]["status"] == "True"
 
 
 def test_controller_pauses_when_cr_deleted():
