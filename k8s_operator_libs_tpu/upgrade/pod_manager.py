@@ -40,6 +40,7 @@ from k8s_operator_libs_tpu.upgrade.node_state_provider import (
 )
 from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
 from k8s_operator_libs_tpu.upgrade.util import (
+    group_clock_start,
     EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
     EventRecorder,
@@ -165,16 +166,9 @@ class PodManager:
         tracked on every host of the group."""
         key = self.keys.pod_completion_start_time_annotation
         now = int(time.time())
-        # Nodes without the annotation get it stamped with 'now'.
-        unstamped = [n for n in group.nodes if key not in n.annotations]
-        if unstamped:
-            self.provider.change_nodes_upgrade_annotation(
-                unstamped, key, str(now)
-            )
-        stamped = [n for n in group.nodes if key in n.annotations]
-        if len(stamped) != group.size():
-            return  # freshly stamped; check again next pass
-        start = min(int(n.annotations[key]) for n in stamped)
+        start = group_clock_start(self.provider, group, key, now)
+        if start is None:
+            return  # freshly stamped; clock evaluated next pass
         if now > start + timeout_seconds:
             self.provider.change_nodes_upgrade_state(
                 group.nodes, UpgradeState.POD_DELETION_REQUIRED

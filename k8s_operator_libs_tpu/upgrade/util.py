@@ -161,6 +161,31 @@ def get_upgrade_state_label_key() -> str:
 # --- shared concurrency helpers --------------------------------------------
 
 
+def group_clock_start(provider, group, key: str, now: int):
+    """Shared start-time clock for group waits (wait-for-jobs and
+    validation timeouts).
+
+    Returns the clock anchor once EVERY member carries the start-time
+    annotation; otherwise stamps the missing members with ``now`` and
+    returns None — the clock is evaluated from the next pass (the batch
+    write refreshes node objects in place, so a stamped-count guard
+    after writing would never fire).
+
+    The anchor is the NEWEST stamp: members are stamped together, so
+    legitimate stamps are ~equal, and an ancient outlier (a crash
+    artifact from a previous cycle whose "null" cleanup didn't land)
+    must not fail the group instantly on re-entry.  Tradeoff, same as
+    the reference's per-node semantics (pod_manager.go:334-371): a
+    member that persistently LOSES its annotation mid-wait restarts the
+    clock — the stuck-state detector attributes the resulting long
+    dwell."""
+    unstamped = [n for n in group.nodes if key not in n.annotations]
+    if unstamped:
+        provider.change_nodes_upgrade_annotation(unstamped, key, str(now))
+        return None
+    return max(int(n.annotations[key]) for n in group.nodes)
+
+
 def run_batch(tasks: list[Callable[[], None]], max_workers: int = 32) -> None:
     """Run callables concurrently; after all complete, raise the first error.
 

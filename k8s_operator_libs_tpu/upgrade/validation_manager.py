@@ -33,6 +33,7 @@ from k8s_operator_libs_tpu.upgrade.node_state_provider import (
 )
 from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
 from k8s_operator_libs_tpu.upgrade.util import (
+    group_clock_start,
     EVENT_TYPE_WARNING,
     EventRecorder,
     UpgradeKeys,
@@ -153,13 +154,9 @@ class ValidationManager:
     def _handle_timeout(self, group: UpgradeGroup) -> None:
         key = self.keys.validation_start_time_annotation
         now = int(time.time())
-        unstamped = [n for n in group.nodes if key not in n.annotations]
-        if unstamped:
-            self.provider.change_nodes_upgrade_annotation(unstamped, key, str(now))
-        stamped = [n for n in group.nodes if key in n.annotations]
-        if len(stamped) != group.size():
-            return
-        start = min(int(n.annotations[key]) for n in stamped)
+        start = group_clock_start(self.provider, group, key, now)
+        if start is None:
+            return  # freshly stamped; clock evaluated next pass
         if self.timeout_seconds and now > start + self.timeout_seconds:
             logger.info("group %s validation timed out -> failed", group.id)
             # The group leaves validation: a stale rejection must not be

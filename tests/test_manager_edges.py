@@ -1,0 +1,238 @@
+"""Drain/validation manager edges not reachable through the happy e2e
+paths: config errors, dedup, the reference-parity shims, provider write
+failures inside async actors, and the PodValidationProber (the
+reference's validation-pod semantics, validation_manager.go:71-136)."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.validation_manager import (
+    PodValidationProber,
+    ValidationManager,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import ClusterFixture, NAMESPACE, make_node
+
+KEYS = UpgradeKeys()
+
+
+def _dm(cluster):
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    return DrainManager(
+        cluster, provider, KEYS, event_recorder=EventRecorder(),
+        poll_interval_s=0.005,
+    )
+
+
+def _group(nodes, gid=None):
+    return UpgradeGroup(
+        id=gid or nodes[0].name,
+        members=[NodeUpgradeState(node=n) for n in nodes],
+    )
+
+
+def _state_of(cluster, nodes):
+    return {
+        n.name: cluster.get_node(n.name, cached=False).labels.get(
+            KEYS.state_label, ""
+        )
+        for n in nodes
+    }
+
+
+# -- drain manager -----------------------------------------------------------
+
+
+def test_drain_config_edges():
+    cluster = FakeCluster()
+    dm = _dm(cluster)
+    dm.schedule_groups_drain(DrainConfiguration(spec=DrainSpec(), groups=[]))
+    with pytest.raises(ValueError, match="drain spec"):
+        dm.schedule_groups_drain(
+            DrainConfiguration(spec=None, groups=[_group([make_node("n")])])
+        )
+    # Disabled drain: a no-op, not an error (the state machine handles
+    # the skip-to-pod-restart transition, not the manager).
+    node = make_node("n0")
+    cluster.create_node(node)
+    dm.schedule_groups_drain(
+        DrainConfiguration(spec=DrainSpec(enable=False), groups=[_group([node])])
+    )
+    assert dm.wait_idle(5.0)
+    assert _state_of(cluster, [node]) == {"n0": ""}
+
+
+def test_drain_dedups_in_flight_groups():
+    cluster = FakeCluster()
+    node = make_node("n0")
+    cluster.create_node(node)
+    dm = _dm(cluster)
+    g = _group([node])
+    dm._draining.add(g.id)
+    dm.schedule_groups_drain(
+        DrainConfiguration(
+            spec=DrainSpec(enable=True, timeout_second=5), groups=[g]
+        )
+    )
+    assert dm.wait_idle(5.0)
+    assert _state_of(cluster, [node]) == {"n0": ""}  # no second worker ran
+    dm._draining.remove(g.id)
+
+
+def test_schedule_nodes_drain_shim_drains_singletons():
+    """Reference-parity surface (drain_manager.go:58): per-node drain for
+    consumers that don't group into slices."""
+    cluster = FakeCluster()
+    nodes = [make_node("n0"), make_node("n1")]
+    for n in nodes:
+        cluster.create_node(n)
+    dm = _dm(cluster)
+    dm.schedule_nodes_drain(
+        DrainSpec(enable=True, timeout_second=5), nodes
+    )
+    assert dm.wait_idle(10.0)
+    assert _state_of(cluster, nodes) == {
+        "n0": "pod-restart-required",
+        "n1": "pod-restart-required",
+    }
+    # Each node was cordoned independently.
+    assert all(
+        cluster.get_node(n.name, cached=False).spec.unschedulable
+        for n in nodes
+    )
+
+
+def test_drain_result_write_failure_is_logged_not_raised():
+    """The async actor must survive a provider write failure — the next
+    idempotent pass re-drives the group (label-mailbox design)."""
+    cluster = FakeCluster()
+    node = make_node("n0")
+    cluster.create_node(node)
+    dm = _dm(cluster)
+
+    # Let the cordon succeed, then fail the state-label write: cordon
+    # goes through set_node_unschedulable which is also patch_node — so
+    # inject only after the first patch by counting calls.
+    calls = {"n": 0}
+
+    def injector(verb):
+        if verb == "patch_node":
+            calls["n"] += 1
+            if calls["n"] > 1:  # first patch = cordon; later = state write
+                raise RuntimeError("injected label-write failure")
+
+    cluster.fault_injector = injector
+    dm.schedule_groups_drain(
+        DrainConfiguration(
+            spec=DrainSpec(enable=True, timeout_second=5),
+            groups=[_group([node])],
+        )
+    )
+    assert dm.wait_idle(10.0)  # worker finished despite the failure
+    cluster.fault_injector = None
+    assert _state_of(cluster, [node]) == {"n0": ""}  # write never landed
+    assert not dm._draining.has("n0")  # and the dedup slot was released
+
+
+# -- PodValidationProber -----------------------------------------------------
+
+
+def test_pod_validation_prober_reference_semantics():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    nodes = [make_node("v0"), make_node("v1")]
+    for n in nodes:
+        cluster.create_node(n)
+    prober = PodValidationProber(cluster, "app=validator")
+    group = _group(nodes, gid="slice:v")
+    # No validation pods anywhere: rejected, names the node.
+    res = prober.probe(group)
+    assert not res.healthy and "v0" in res.detail
+    # Pod on one node only: the other still rejects.
+    fx.workload_pod(
+        nodes[0], name="val-0", labels={"app": "validator"},
+        namespace=NAMESPACE,
+    )
+    res = prober.probe(group)
+    assert not res.healthy and "v1" in res.detail
+    # Pods on both but one not Ready: rejected, names the pod.
+    bad = fx.workload_pod(
+        nodes[1], name="val-1", labels={"app": "validator"},
+        namespace=NAMESPACE, phase="Pending",
+    )
+    res = prober.probe(group)
+    assert not res.healthy and bad.name in res.detail
+    # All Running+Ready: validated.
+    cluster.delete_pod(NAMESPACE, bad.name)
+    fx.workload_pod(
+        nodes[1], name="val-2", labels={"app": "validator"},
+        namespace=NAMESPACE,
+    )
+    assert prober.probe(group).healthy
+    # Empty selector = validation disabled (reference default).
+    assert PodValidationProber(cluster, "").probe(group).healthy
+
+
+def test_validation_partial_stamp_waits_for_full_group():
+    """A timeout clock only starts once EVERY member is stamped — a
+    partially-stamped group (crash artifact) waits one more pass."""
+    cluster = FakeCluster()
+    nodes = [make_node("n0"), make_node("n1")]
+    for n in nodes:
+        cluster.create_node(n)
+    key = KEYS.validation_start_time_annotation
+    cluster.patch_node_annotations("n0", {key: "1"})
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    vm = ValidationManager(cluster, provider, KEYS, timeout_seconds=1)
+
+    class Reject:
+        def probe(self, group):
+            from k8s_operator_libs_tpu.health.slice_prober import ProbeResult
+
+            return ProbeResult(False, "nope")
+
+    vm.prober = Reject()
+    fresh = [cluster.get_node(n.name, cached=False) for n in nodes]
+    assert vm.validate(_group(fresh)) is False
+    # n1 was stamped this pass; no FAILED transition yet even though n0's
+    # ancient stamp is past the timeout.
+    after = _state_of(cluster, nodes)
+    assert all(s == "" for s in after.values())
+    assert key in cluster.get_node("n1", cached=False).annotations
+
+
+def test_rollback_eviction_failure_is_best_effort():
+    """A PDB-blocked rollback eviction logs and finishes; it must not
+    wedge the worker or crash validation."""
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    node = make_node("n0")
+    cluster.create_node(node)
+    pod = fx.workload_pod(node, name="stuck", namespace=NAMESPACE)
+    cluster.set_eviction_blocked(NAMESPACE, pod.name, True)
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    vm = ValidationManager(cluster, provider, KEYS)
+    vm.rollback_drain_timeout_s = 0.5
+    vm.rollback_poll_interval_s = 0.01
+    vm._schedule_rollback_eviction(_group([node]))
+    assert vm.wait_idle(15.0)
+    # The blocked pod survived (best-effort), nothing raised.
+    assert cluster.get_pod(NAMESPACE, "stuck") is not None
