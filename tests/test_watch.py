@@ -284,3 +284,46 @@ def test_watch_driven_controller_is_event_bound(tier):
         finally:
             controller.stop()
             thread.join(15.0)
+
+
+def test_watch_pump_reconnects_after_stream_error():
+    """The controller's pump must survive a broken stream (apiserver
+    restart) and keep delivering wake signals afterwards."""
+    cluster = FakeCluster()
+    controller = UpgradeController(
+        cluster,
+        ControllerConfig(
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            watch=True,
+            hbm_floor_fraction=0.0,
+        ),
+    )
+    attempts = {"n": 0}
+
+    def flaky_watch_events(kinds=None):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("stream broke")
+        yield None
+        while True:
+            ev = object()
+            yield ev
+            time.sleep(0.01)
+
+    controller.client = type(
+        "FlakyClient", (), {"watch_events": staticmethod(flaky_watch_events)}
+    )()
+    wake = threading.Event()
+    thread = threading.Thread(
+        target=controller._watch_pump, args=(wake,), daemon=True
+    )
+    # Reconnect backoff is 1s; shrink the wait by monkeypatching sleep?
+    # No — accept the 1s: the pump must come back and set the flag.
+    thread.start()
+    try:
+        assert wake.wait(10.0), "pump never recovered from the broken stream"
+        assert attempts["n"] >= 2  # first stream raised, second delivered
+    finally:
+        controller.stop()
+        thread.join(5.0)
