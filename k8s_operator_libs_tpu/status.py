@@ -1,0 +1,212 @@
+"""Read-only upgrade status summary: the operator's mid-roll view.
+
+    python -m k8s_operator_libs_tpu.status \
+        --namespace kube-system --selector app=libtpu-driver [--json]
+
+Snapshots the cluster exactly the way the engine does (BuildState — no
+writes) and prints per-slice state, host counts, availability, the
+driver's current ControllerRevision, policy-CR conditions when present,
+and recent Warning events.  This is the human/scripting face of the
+same facts the controller acts on; the reference leaves this to kubectl
+one-liners over its labels (docs/automatic-ofed-upgrade.md
+troubleshooting section).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json as _json
+from typing import Optional
+
+from k8s_operator_libs_tpu.upgrade.upgrade_state import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+
+
+def gather(
+    client,
+    namespace: str,
+    driver_labels: dict[str, str],
+    keys: Optional[UpgradeKeys] = None,
+    policy_ref: Optional[tuple[str, str]] = None,
+    max_events: int = 10,
+) -> dict:
+    """Collect the status snapshot as a JSON-shaped dict (no writes)."""
+    keys = keys or UpgradeKeys()
+    # Fetch + parse the policy FIRST: grouping can depend on it
+    # (slice_atomic, topology overrides), and the controller passes its
+    # policy into build_state — showing a different grouping here would
+    # misrepresent what the engine acts on.
+    policy = None
+    policy_section: Optional[dict] = None
+    if policy_ref is not None:
+        from k8s_operator_libs_tpu.api import TPUUpgradePolicySpec
+        from k8s_operator_libs_tpu.api.schema import (
+            POLICY_GROUP,
+            POLICY_PLURAL,
+            POLICY_VERSION,
+        )
+        from k8s_operator_libs_tpu.k8s.client import NotFoundError
+
+        try:
+            cr = client.get_custom_object(
+                POLICY_GROUP,
+                POLICY_VERSION,
+                POLICY_PLURAL,
+                policy_ref[0],
+                policy_ref[1],
+            )
+            policy_section = {
+                "spec": cr.get("spec") or {},
+                "conditions": (cr.get("status") or {}).get("conditions", []),
+            }
+            try:
+                policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
+            except (ValueError, TypeError):
+                policy = None
+        except NotFoundError:
+            policy_section = {"error": "policy CR not found"}
+    mgr = ClusterUpgradeStateManager(client, keys=keys)
+    try:
+        state = mgr.build_state(namespace, driver_labels, policy)
+    except BuildStateError as e:
+        return {"error": f"snapshot incoherent: {e} (mid-rollout; retry)"}
+    groups = []
+    for group in sorted(state.all_groups(), key=lambda g: g.id):
+        effective = group.effective_state(keys.state_label).value or "idle"
+        member_states = {
+            m.node.name: m.node.labels.get(keys.state_label, "")
+            for m in group.members
+        }
+        unavailable = sum(
+            1
+            for m in group.members
+            if m.node.spec.unschedulable or not m.node.is_ready()
+        )
+        groups.append(
+            {
+                "group": group.id,
+                "state": effective,
+                "hosts": group.size(),
+                "unavailable": unavailable,
+                "accelerator": (
+                    group.slice_info.accelerator if group.slice_info else ""
+                ),
+                "topology": (
+                    group.slice_info.topology if group.slice_info else ""
+                ),
+                "dcn_group": (
+                    group.slice_info.dcn_group
+                    if group.slice_info and group.slice_info.dcn_group
+                    else ""
+                ),
+                "members": member_states,
+            }
+        )
+    out = {
+        "totalManagedNodes": mgr.get_total_managed_nodes(state),
+        "totalManagedGroups": mgr.get_total_managed_groups(state),
+        "upgradesInProgress": mgr.get_upgrades_in_progress(state),
+        "upgradesDone": mgr.get_upgrades_done(state),
+        "upgradesFailed": mgr.get_upgrades_failed(state),
+        "upgradesPending": mgr.get_upgrades_pending(state),
+        "groups": groups,
+    }
+    if policy_section is not None:
+        out["policy"] = policy_section
+    if hasattr(client, "list_events"):
+        warnings = [
+            e
+            for e in client.list_events(namespace=namespace)
+            if e.get("type") == "Warning"
+        ]
+        # Wire order is not time order on a real apiserver: sort by the
+        # event timestamps (ISO strings sort correctly) before slicing.
+        warnings.sort(
+            key=lambda e: e.get("lastTimestamp")
+            or e.get("firstTimestamp")
+            or ""
+        )
+        out["recentWarnings"] = [
+            {
+                "object": (e.get("involvedObject") or {}).get("name", ""),
+                "reason": e.get("reason", ""),
+                "message": e.get("message", ""),
+            }
+            for e in warnings[-max_events:]
+        ]
+    return out
+
+
+def render(status: dict) -> str:
+    """Human-readable table of the gathered snapshot."""
+    if "error" in status:
+        return f"status unavailable: {status['error']}"
+    lines = [
+        f"nodes: {status['totalManagedNodes']} in {status['totalManagedGroups']} "
+        f"group(s) | in-progress {status['upgradesInProgress']} "
+        f"pending {status['upgradesPending']} done {status['upgradesDone']} "
+        f"failed {status['upgradesFailed']}",
+        "",
+        f"{'GROUP':32s} {'STATE':24s} {'HOSTS':>5s} {'UNAVAIL':>7s} "
+        f"{'TOPOLOGY':10s} DCN",
+    ]
+    for g in status["groups"]:
+        lines.append(
+            f"{g['group'][:32]:32s} {g['state']:24s} {g['hosts']:>5d} "
+            f"{g['unavailable']:>7d} {g['topology']:10s} {g['dcn_group']}"
+        )
+    policy = status.get("policy")
+    if policy is not None:
+        lines.append("")
+        if "error" in policy:
+            lines.append(f"policy: {policy['error']}")
+        else:
+            for c in policy.get("conditions", []):
+                lines.append(
+                    f"condition {c.get('type', ''):12s} "
+                    f"{c.get('status', ''):6s} {c.get('reason', '')}: "
+                    f"{c.get('message', '')}"
+                )
+    warnings = status.get("recentWarnings") or []
+    if warnings:
+        lines.append("")
+        lines.append("recent warnings:")
+        for w in warnings:
+            lines.append(
+                f"  {w['object']}: {w['reason']}: {w['message']}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--namespace", default="kube-system")
+    parser.add_argument("--selector", default="app=libtpu-driver")
+    parser.add_argument("--driver-name", default="libtpu")
+    parser.add_argument("--policy-cr", default="", metavar="NAMESPACE/NAME")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    from k8s_operator_libs_tpu.controller import _parse_labels
+    from k8s_operator_libs_tpu.k8s import get_default_client
+
+    policy_ref = None
+    if args.policy_cr:
+        ns, sep, name = args.policy_cr.partition("/")
+        if not sep or not ns or not name:
+            parser.error("--policy-cr must look like NAMESPACE/NAME")
+        policy_ref = (ns, name)
+    status = gather(
+        get_default_client(),
+        args.namespace,
+        _parse_labels(args.selector),
+        keys=UpgradeKeys(driver_name=args.driver_name),
+        policy_ref=policy_ref,
+    )
+    print(_json.dumps(status, indent=2) if args.as_json else render(status))
+
+
+if __name__ == "__main__":
+    main()

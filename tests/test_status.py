@@ -1,0 +1,168 @@
+"""The read-only status CLI: mid-roll truth for operators."""
+
+from __future__ import annotations
+
+import json
+
+from k8s_operator_libs_tpu.api.schema import (
+    POLICY_GROUP,
+    POLICY_PLURAL,
+    POLICY_VERSION,
+    register_policy_crd,
+)
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.status import gather, render
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+
+def _mid_roll_cluster():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    slices = {
+        f"pool-{i}": fx.tpu_slice(f"pool-{i}", hosts=2, topology="2x2x2",
+                                  dcn_group="ring-a" if i < 2 else None)
+        for i in range(3)
+    }
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    cluster.create_custom_object(
+        POLICY_GROUP,
+        POLICY_VERSION,
+        POLICY_PLURAL,
+        NAMESPACE,
+        {
+            "metadata": {"name": "rollout"},
+            "spec": {
+                "autoUpgrade": True,
+                "maxParallelUpgrades": 1,
+                "drain": {"enable": True, "timeoutSeconds": 5},
+                "healthGate": {"enable": False},
+            },
+        },
+    )
+    controller = UpgradeController(
+        cluster,
+        ControllerConfig(
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            interval_s=0.01,
+            policy=None,
+            policy_ref=(NAMESPACE, "rollout"),
+            hbm_floor_fraction=0.0,
+        ),
+    )
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    # A few passes: slice 0 mid-flight, others pending (1 slot).
+    for _ in range(3):
+        controller.reconcile_once()
+        controller.manager.wait_for_async_work(10.0)
+    return cluster, keys
+
+
+def test_gather_mid_roll_snapshot():
+    cluster, keys = _mid_roll_cluster()
+    status = gather(
+        cluster, NAMESPACE, DRIVER_LABELS, keys=keys,
+        policy_ref=(NAMESPACE, "rollout"),
+    )
+    assert status["totalManagedNodes"] == 6
+    assert status["totalManagedGroups"] == 3
+    assert status["upgradesInProgress"] >= 1  # one slice holds the slot
+    by_id = {g["group"]: g for g in status["groups"]}
+    assert len(by_id) == 3
+    moving = [g for g in status["groups"] if g["state"] not in
+              ("idle", "upgrade-required", "upgrade-done")]
+    assert moving, status["groups"]
+    sample = status["groups"][0]
+    assert sample["hosts"] == 2
+    assert sample["topology"] == "2x2x2"
+    assert by_id["pool-0"]["dcn_group"] == "ring-a"
+    assert by_id["pool-2"]["dcn_group"] == ""
+    # Per-member drill-down matches the live labels.
+    for g in status["groups"]:
+        for node_name, state in g["members"].items():
+            assert (
+                cluster.get_node(node_name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                == state
+            )
+    # Policy section carries spec + conditions from the CR.
+    assert status["policy"]["spec"]["maxParallelUpgrades"] == 1
+    cond_types = {c["type"] for c in status["policy"]["conditions"]}
+    assert {"Progressing", "Degraded", "Complete"} <= cond_types
+
+
+def test_render_and_json_shapes():
+    cluster, keys = _mid_roll_cluster()
+    status = gather(
+        cluster, NAMESPACE, DRIVER_LABELS, keys=keys,
+        policy_ref=(NAMESPACE, "rollout"),
+    )
+    text = render(status)
+    assert "GROUP" in text and "pool-0" in text
+    assert "condition Progressing" in text
+    # The dict is JSON-serializable as-is (the --json mode contract).
+    round_tripped = json.loads(json.dumps(status))
+    assert round_tripped["totalManagedGroups"] == 3
+
+
+def test_missing_policy_cr_and_warnings_render():
+    cluster = FakeCluster()
+    register_policy_crd(cluster)
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    node = fx.tpu_slice("pool-a", hosts=1, topology="2x2x1")[0]
+    fx.driver_pod(node, ds, hash_suffix="v1")
+    cluster.create_event(
+        NAMESPACE,
+        {
+            "metadata": {"name": "n.w"},
+            "involvedObject": {"kind": "Node", "name": node.name},
+            "type": "Warning",
+            "reason": "DrainFailed",
+            "message": "boom",
+        },
+    )
+    status = gather(
+        cluster, NAMESPACE, DRIVER_LABELS, keys=keys,
+        policy_ref=(NAMESPACE, "absent"),
+    )
+    assert status["policy"] == {"error": "policy CR not found"}
+    assert status["recentWarnings"] == [
+        {"object": node.name, "reason": "DrainFailed", "message": "boom"}
+    ]
+    text = render(status)
+    assert "policy CR not found" in text
+    assert "DrainFailed: boom" in text
+
+
+def test_gather_reports_incoherent_snapshot():
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    node = fx.tpu_slice("pool-a", hosts=1, topology="2x2x1")[0]
+    fx.driver_pod(node, ds, hash_suffix="v1")
+    # Desired count mismatch: BuildStateError path.
+    ds.status.desired_number_scheduled = 5
+    cluster.update_daemon_set(ds)
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert "error" in status
+    assert "retry" in render(status)
+
+
+def test_status_cli_unused_policy_section_absent():
+    cluster, keys = _mid_roll_cluster()
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert "policy" not in status
