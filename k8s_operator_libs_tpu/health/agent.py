@@ -111,6 +111,7 @@ class HealthAgent:
         hbm_mib: int = 1024,
         allreduce_elems: int = 1 << 20,
         deep: bool = False,
+        max_iters: Optional[int] = None,
         dcn_peers: Optional[Sequence[str]] = None,
     ) -> None:
         self.client = client
@@ -123,11 +124,20 @@ class HealthAgent:
         self.hbm_mib = hbm_mib
         self.allreduce_elems = allreduce_elems
         self.deep = deep
+        # Sustained-measurement iteration cap.  None = the probes'
+        # escalating default (best accuracy; right for a production agent
+        # that owns an idle quiesced host).  Bounded values trade
+        # precision for a hard ceiling on battery wall-time — for rigs
+        # where the agent shares a chip with a workload (the 1-chip
+        # bench) a pass/fail verdict against a 50%-of-spec floor does
+        # not need deep escalation.
+        self.max_iters = max_iters
         # "host[:port]" peer-slice endpoints across the DCN; when set the
         # battery includes dcn_reachability (BASELINE config 5).
         self.dcn_peers = list(dcn_peers) if dcn_peers else None
 
     def probe_once(self) -> HealthReport:
+        kwargs = {} if self.max_iters is None else {"max_iters": self.max_iters}
         checks = run_host_probe(
             self.devices,
             matmul_n=self.matmul_n,
@@ -135,6 +145,7 @@ class HealthAgent:
             allreduce_elems=self.allreduce_elems,
             deep=self.deep,
             dcn_peers=self.dcn_peers,
+            **kwargs,
         )
         # Derive the visible-device count from the enumeration check
         # rather than re-calling jax.devices(): when libtpu is broken (the

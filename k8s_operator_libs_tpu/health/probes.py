@@ -28,6 +28,7 @@ static; verification happens on host after ``block_until_ready``.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -92,8 +93,36 @@ def _timed(fn, *args) -> tuple[float, object]:
 # dominates a small op; absurdly HIGH when the runtime's
 # block_until_ready does not actually wait, as on tunneled remote
 # backends) — either way useless for threshold policies.
-DEFAULT_MIN_TIME_S = 0.05
-_MAX_SUSTAINED_ITERS = 256
+def _min_time_from_env() -> float:
+    raw = os.environ.get("K8S_TPU_PROBE_MIN_TIME_S", "")
+    try:
+        return float(raw) if raw else 0.05
+    except ValueError:
+        logger.warning(
+            "ignoring malformed K8S_TPU_PROBE_MIN_TIME_S=%r "
+            "(want seconds as a float); using 0.05",
+            raw,
+        )
+        return 0.05
+
+
+DEFAULT_MIN_TIME_S = _min_time_from_env()
+_MAX_SUSTAINED_ITERS = 2048
+# Initial k1 is capped low (fast probes stay fast); the differential
+# check below escalates toward _MAX_SUSTAINED_ITERS//4 only when the
+# measured slope doesn't hold enough device work to trust.
+_INIT_SUSTAINED_ITERS = 256
+
+
+# Injectable for unit tests (a fake must not leak to other perf_counter
+# callers in the process — jax's own dispatch uses the stdlib one).
+_perf_counter = time.perf_counter
+
+
+def _median(xs: list) -> float:
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2
 
 
 class InconclusiveTiming(RuntimeError):
@@ -165,7 +194,7 @@ def _timed_sustained(
     def run(iters: int, start) -> float:
         cur = start
         out = None
-        t0 = time.perf_counter()
+        t0 = _perf_counter()
         for i in range(iters):
             out = fn(*cur)
             if chain:
@@ -179,7 +208,7 @@ def _timed_sustained(
             if flush_every and (i + 1) % flush_every == 0:
                 jax.block_until_ready(out)
         _sync_readback(out)
-        elapsed = time.perf_counter() - t0
+        elapsed = _perf_counter() - t0
         state["out"] = out
         state["applied"] += iters
         return elapsed
@@ -208,43 +237,89 @@ def _timed_sustained(
         k1 = 16
     else:
         per_est = max(pilot_s / 2, 1e-7)
-        k1 = max(16, min(max_iters // 4, int(min_time_s / per_est) + 1))
+        init_cap = min(_INIT_SUSTAINED_ITERS, max_iters // 4)
+        k1 = max(16, min(init_cap, int(min_time_s / per_est) + 1))
     k2 = 4 * k1
-    # One untimed k1-length warm run: the first measured runs after
-    # process start are systematically skewed on tunneled backends (the
+    # One k1-length warm run: the first measured runs after process
+    # start are systematically skewed on tunneled backends (the
     # runtime's stream/flush machinery is still warming), which shows up
-    # as a consistently non-monotonic first slope pair.
-    run(k1, start_args())
+    # as a consistently non-monotonic first slope pair.  Its elapsed
+    # time also RE-SIZES k1: the pilot's per-op estimate is dominated by
+    # the fixed dispatch/readback cost on remote backends, which
+    # under-sizes k1 for fast ops (an n=4096 matmul is ~0.7 ms on the
+    # MXU vs tens of ms of tunnel round trip), drowning the slope in
+    # transport jitter.
+    warm_s = run(k1, start_args())
+    if not deterministic:
+        per_warm = max(warm_s / k1, 1e-9)
+        resized = int(min_time_s / per_warm) + 1
+        if resized > k1:
+            k1 = min(max_iters // 4, resized)
+            k2 = 4 * k1
     # Measure three slope pairs and take the MEDIAN of the valid
     # (monotonic) slopes.  One noisy measurement must not flip a health
     # verdict in EITHER direction: a host stall during the long run
     # deflates throughput (false floor failure — the r2 flakiness), a
     # stall during the short run inflates it (a >100 % MFU fiction that
     # sails over every floor).  The median of three rejects a single
-    # contaminated pair on both sides; with no valid pair at all the
-    # measurement is inconclusive — clamping a still-invalid slope would
-    # report absurd throughput as a passing figure.
+    # contaminated pair on both sides.
+    #
+    # A slope is TRUSTED only when its numerator — the k2−k1 differential,
+    # which is pure device work (fixed costs cancel) — holds at least
+    # min_time_s.  A monotonic-but-tiny differential is indistinguishable
+    # from transport jitter and reads as absurd throughput (the r3 bench's
+    # over-peak MXU figure).  Untrusted or all-invalid measurements
+    # ESCALATE: quadruple the run length (amortizing the jitter) and
+    # re-measure, up to the iteration cap.  Never under ``deterministic``:
+    # escalation is a timing-dependent decision and SPMD processes must
+    # enqueue identical collective counts.  At the cap, valid slopes are
+    # accepted as-is (callers still reject over-spec figures); with no
+    # valid pair at all the measurement is inconclusive — clamping an
+    # invalid slope would report fiction as a passing figure.
     slopes: list[float] = []
     pairs: list[tuple[float, float]] = []
-    for _ in range(3):
-        t1 = run(k1, start_args())
-        t2 = run(k2, start_args())
-        pairs.append((t1, t2))
-        if t2 > t1:
-            slopes.append((t2 - t1) / (k2 - k1))
-    if not slopes:
-        raise InconclusiveTiming(
-            f"unstable timing: {k1}- vs {k2}-iteration runs were "
-            f"non-monotonic in all {len(pairs)} attempts ({pairs}); "
-            "cannot measure sustained rate",
-            state["out"],
-            state["applied"],
-        )
-    slopes.sort()
-    per_s = slopes[len(slopes) // 2] if len(slopes) % 2 else (
-        (slopes[len(slopes) // 2 - 1] + slopes[len(slopes) // 2]) / 2
-    )
-    return per_s * 1e3, state["out"], state["applied"]
+    while True:
+        slopes.clear()
+        pairs.clear()
+        diffs: list[float] = []
+        for _ in range(3):
+            t1 = run(k1, start_args())
+            t2 = run(k2, start_args())
+            pairs.append((t1, t2))
+            if t2 > t1:
+                slopes.append((t2 - t1) / (k2 - k1))
+                diffs.append(t2 - t1)
+        at_cap = deterministic or k1 >= max_iters // 4
+        if slopes:
+            med_diff = _median(diffs)
+            # Trust needs BOTH enough differential device work and
+            # mutually consistent slopes: at a too-short window every
+            # pair can be monotonic yet noise-skewed the same way (a
+            # 2-3x-under-rate figure the median happily reports).
+            # Disagreeing slopes at a long-enough window mean the
+            # environment is noisy at every scale — escalate further.
+            consistent = (
+                len(slopes) == 3 and max(slopes) <= 1.5 * min(slopes)
+            )
+            if at_cap or (med_diff >= min_time_s and consistent):
+                break
+            # Jump straight to the run length whose differential holds
+            # min_time_s (each escalation round costs 8 host round trips
+            # on remote backends — a ×4 ladder would pay that per rung).
+            needed = int(k1 * min_time_s / max(med_diff, 1e-9)) + 1
+            k1 = min(max_iters // 4, max(k1 * 4, needed))
+        elif at_cap:
+            raise InconclusiveTiming(
+                f"unstable timing: {k1}- vs {k2}-iteration runs were "
+                f"non-monotonic in all {len(pairs)} attempts ({pairs}); "
+                "cannot measure sustained rate",
+                state["out"],
+                state["applied"],
+            )
+        else:
+            k1 = min(k1 * 4, max_iters // 4)
+        k2 = 4 * k1
+    return _median(slopes) * 1e3, state["out"], state["applied"]
 
 
 def device_inventory(
@@ -284,6 +359,7 @@ def matmul_probe(
     n: int = 4096,
     dtype=jnp.bfloat16,
     min_time_s: float = DEFAULT_MIN_TIME_S,
+    max_iters: int = _MAX_SUSTAINED_ITERS,
 ) -> CheckResult:
     """MXU correctness + sustained throughput with an analytic result.
 
@@ -320,7 +396,8 @@ def matmul_probe(
         a = jax.device_put(jnp.full((n, n), a_val, dtype=dtype), device)
         b = jax.device_put(jnp.full((n, n), b_val, dtype=dtype), device)
         latency_ms, out, iters = _timed_sustained(
-            mm, (a, b), min_time_s=min_time_s, chain=True
+            mm, (a, b), min_time_s=min_time_s, chain=True,
+            max_iters=max_iters,
         )
         got = np.asarray(out).astype(np.float32)
     except InconclusiveTiming as e:
@@ -383,6 +460,7 @@ def hbm_bandwidth_probe(
     device: Optional[jax.Device] = None,
     mib: int = 1024,
     min_time_s: float = DEFAULT_MIN_TIME_S,
+    max_iters: int = _MAX_SUSTAINED_ITERS,
 ) -> CheckResult:
     """Sustained HBM stream: chained ``x ← x + 1`` over a ``mib``-MiB f32
     array (default 1 GiB — large enough that one pass is pure HBM
@@ -404,7 +482,8 @@ def hbm_bandwidth_probe(
     try:
         x = jax.device_put(jnp.zeros((elems,), jnp.float32), device)
         latency_ms, out, iters = _timed_sustained(
-            stream, (x,), min_time_s=min_time_s, chain=True
+            stream, (x,), min_time_s=min_time_s, chain=True,
+            max_iters=max_iters,
         )
         sample = np.asarray(out[:8])
     except InconclusiveTiming as e:
@@ -470,6 +549,7 @@ def ici_allreduce_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     per_device_elems: int = 1 << 20,
     min_time_s: float = DEFAULT_MIN_TIME_S,
+    max_iters: int = _MAX_SUSTAINED_ITERS,
 ) -> CheckResult:
     """All-reduce (`psum`) across every chip of the slice mesh.
 
@@ -517,7 +597,7 @@ def ici_allreduce_probe(
         )
         latency_ms, out, iters = _timed_sustained(
             fn, (x,), min_time_s=min_time_s, flush_every=16,
-            deterministic=multi_process,
+            deterministic=multi_process, max_iters=max_iters,
         )
         got = _addressable_numpy(out)
     except InconclusiveTiming as e:
@@ -743,6 +823,7 @@ def run_host_probe(
     skip_ici: bool = False,
     deep: bool = False,
     min_time_s: float = DEFAULT_MIN_TIME_S,
+    max_iters: int = _MAX_SUSTAINED_ITERS,
     dcn_peers: Optional[Sequence[str]] = None,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
@@ -777,14 +858,23 @@ def run_host_probe(
     # (single-process) view.
     local = [d for d in devs if d.process_index == d.client.process_index()]
     probe_dev = local[0] if local else devs[0]
-    results.append(matmul_probe(probe_dev, n=matmul_n, min_time_s=min_time_s))
     results.append(
-        hbm_bandwidth_probe(probe_dev, mib=hbm_mib, min_time_s=min_time_s)
+        matmul_probe(
+            probe_dev, n=matmul_n, min_time_s=min_time_s, max_iters=max_iters
+        )
+    )
+    results.append(
+        hbm_bandwidth_probe(
+            probe_dev, mib=hbm_mib, min_time_s=min_time_s, max_iters=max_iters
+        )
     )
     if not skip_ici:
         results.append(
             ici_allreduce_probe(
-                devs, per_device_elems=allreduce_elems, min_time_s=min_time_s
+                devs,
+                per_device_elems=allreduce_elems,
+                min_time_s=min_time_s,
+                max_iters=max_iters,
             )
         )
         results.append(ici_ring_probe(devs))

@@ -47,6 +47,8 @@ class ClusterFixture:
         self.client = client
         self.keys = keys or UpgradeKeys()
         self.namespace = namespace
+        # Per-DaemonSet recreate-hook state (see auto_recreate_driver_pods).
+        self._recreate_state: dict = {}
 
     # -- daemonsets ----------------------------------------------------------
 
@@ -236,9 +238,24 @@ class ClusterFixture:
         self, ds: DaemonSet, hash_suffix: str, ready: bool = True
     ) -> None:
         """Emulate the DaemonSet controller: when a driver pod dies, recreate
-        it from the current template (new revision hash)."""
+        it from the current template (new revision hash).
+
+        Calling again for the same DaemonSet (a second template bump,
+        multi-revision scenarios) UPDATES the recreate hash instead of
+        stacking a second hook — two live hooks would race to recreate
+        the pod at different revisions."""
+        state = self._recreate_state.setdefault(
+            ds.metadata.uid, {"registered": False}
+        )
+        state["hash"] = hash_suffix
+        state["ready"] = ready
+        if state["registered"]:
+            return
+        state["registered"] = True
 
         def hook(pod: Pod) -> None:
+            hash_suffix = state["hash"]
+            ready = state["ready"]
             selector = ds.spec.selector.match_labels
             if not all(pod.labels.get(k) == v for k, v in selector.items()):
                 return
