@@ -97,6 +97,16 @@ class ControllerConfig:
     # core/v1 Events (reference parity: every transition is an Event,
     # visible in `kubectl describe node`).
     publish_events: bool = True
+    # HA: run leader election over a coordination.k8s.io/v1 Lease and
+    # reconcile only while holding it — required whenever 2+ controller
+    # replicas run (the consumer-operator pattern: controller-runtime
+    # managers do the same before starting their reconcilers).
+    leader_elect: bool = False
+    lease_name: str = "tpu-upgrade-controller"
+    # Defaults to ``namespace`` when None.
+    lease_namespace: Optional[str] = None
+    # Candidate identity; auto hostname_uuid when empty.
+    identity: str = ""
 
 
 class UpgradeController:
@@ -140,6 +150,23 @@ class UpgradeController:
         self.slice_timer = SliceUpgradeTimer(self.registry)
         # Stuck-state dwell gauge flows into the same registry.
         self.manager.stuck_detector.registry = self.registry
+        self.elector = None
+        if config.leader_elect:
+            from k8s_operator_libs_tpu.k8s.leader import (
+                LeaderElector,
+                ensure_lease_kind,
+            )
+
+            # No-op on real clusters (coordination.k8s.io is built in);
+            # required on the FakeCluster/KubeApiServer tiers, where an
+            # unregistered kind would fail every election round.
+            ensure_lease_kind(client)
+            self.elector = LeaderElector(
+                client,
+                identity=config.identity or None,
+                namespace=config.lease_namespace or config.namespace,
+                name=config.lease_name,
+            )
         self._stop = False
         # Policy-CR bookkeeping: the CR fetched this pass (reused for the
         # status write) and whether "missing" was already logged.
@@ -148,6 +175,9 @@ class UpgradeController:
         # Set while run_forever is in watch mode so stop() can interrupt
         # a long resync wait.
         self._wake: Optional[threading.Event] = None
+        # Election bookkeeping (leader_elect mode).
+        self._last_election_at: Optional[float] = None
+        self._was_leader = False
 
     def reconcile_once(self) -> bool:
         """One full pass; returns False when the snapshot was incoherent
@@ -155,6 +185,8 @@ class UpgradeController:
         t0 = time.monotonic()
         if self.config.policy_ref is not None:
             self._refresh_policy_from_cr()
+        if not self._still_leading():
+            return False
         if self.ds_reconciler is not None:
             self.ds_reconciler.reconcile()
         if self.agent_reconciler is not None:
@@ -170,6 +202,13 @@ class UpgradeController:
             )
         except BuildStateError as e:
             logger.warning("build_state: %s (requeueing)", e)
+            return False
+        # Re-check right before the mutating phase: a pass that outlived
+        # the renew deadline (apiserver latency, huge snapshot) must not
+        # cordon/drain concurrently with a successor that has already
+        # taken over.  is_leader() goes False at the renew deadline,
+        # BEFORE anyone else's observed term expires.
+        if not self._still_leading():
             return False
         self.manager.apply_state(state, self.config.policy)
         if self.config.policy_ref is not None:
@@ -414,6 +453,49 @@ class UpgradeController:
         if self._wake is not None:
             self._wake.set()  # interrupt a watch-mode resync wait
 
+    def _still_leading(self) -> bool:
+        """Mid-pass leadership guard; True when not in leader-elect mode."""
+        if self.elector is None or self.elector.is_leader():
+            return True
+        logger.warning(
+            "leadership lost mid-pass (identity=%s); aborting reconcile",
+            self.elector.identity,
+        )
+        return False
+
+    def _election_round(self) -> bool:
+        """Renew/acquire at the elector's retry cadence; between renewals
+        trust ``is_leader()`` (itself bounded by the renew deadline, so a
+        partitioned holder stands down before its term expires for
+        anyone else).  Called at the top of every pass AND from inside
+        the inter-pass waits — a 30 s reconcile interval must not starve
+        a 10 s renew deadline."""
+        e = self.elector
+        now = time.monotonic()
+        if (
+            self._last_election_at is None
+            or now - self._last_election_at >= e.retry_period_s
+            or not e.is_leader()
+        ):
+            self._last_election_at = now
+            leading = e.acquire_or_renew()
+        else:
+            leading = True
+        self.registry.set(
+            "tpu_upgrade_controller_is_leader",
+            1.0 if leading else 0.0,
+            identity=e.identity,
+        )
+        if leading != self._was_leader:
+            logger.info(
+                "%s leadership (lease=%s identity=%s)",
+                "gained" if leading else "lost",
+                self.config.lease_name,
+                e.identity,
+            )
+        self._was_leader = leading
+        return leading
+
     def _watch_kinds(self) -> list[str]:
         kinds = ["Node", "Pod", "DaemonSet"]
         if self.config.policy_ref is not None:
@@ -465,6 +547,15 @@ class UpgradeController:
         )
         try:
             while not self._stop:
+                if self.elector is not None and not self._election_round():
+                    # Standby: never reconcile without the lease; retry
+                    # at the election cadence.
+                    deadline = (
+                        time.monotonic() + self.elector.retry_period_s
+                    )
+                    while not self._stop and time.monotonic() < deadline:
+                        time.sleep(0.05)
+                    continue
                 if wake is not None:
                     # Clear BEFORE reconciling: an event that lands
                     # mid-pass must trigger another pass, not be lost.
@@ -475,15 +566,43 @@ class UpgradeController:
                     logger.exception("reconcile pass failed")
                 if wake is not None:
                     # Event-driven: wake on the first change, or resync
-                    # after the full interval.
-                    woken = wake.wait(self.config.interval_s)
+                    # after the full interval.  Chunked so a leader keeps
+                    # renewing its lease while idle; losing it aborts the
+                    # wait (the top of the loop goes standby).
+                    deadline = time.monotonic() + self.config.interval_s
+                    woken = False
+                    while not self._stop and not woken:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        chunk = (
+                            min(remaining, self.elector.retry_period_s)
+                            if self.elector is not None
+                            else remaining
+                        )
+                        woken = wake.wait(chunk)
+                        if (
+                            self.elector is not None
+                            and not self._election_round()
+                        ):
+                            woken = False
+                            break
                     if woken and self.config.watch_debounce_s > 0:
                         time.sleep(self.config.watch_debounce_s)
                     continue
                 deadline = time.monotonic() + self.config.interval_s
                 while not self._stop and time.monotonic() < deadline:
                     time.sleep(0.2)
+                    if (
+                        self.elector is not None
+                        and not self._election_round()
+                    ):
+                        break
         finally:
+            if self.elector is not None:
+                # Clean shutdown hands the lease over immediately instead
+                # of making the successor wait out the term.
+                self.elector.release()
             if server is not None:
                 server.stop()
 
@@ -566,6 +685,22 @@ def main(argv: Optional[list[str]] = None) -> None:
         "the policy CR) and reconcile on change; --interval becomes the "
         "periodic-resync fallback",
     )
+    parser.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="run leader election over a coordination.k8s.io Lease and "
+        "reconcile only while holding it (required with 2+ replicas)",
+    )
+    parser.add_argument(
+        "--lease-name",
+        default="tpu-upgrade-controller",
+        help="Lease object name for --leader-elect",
+    )
+    parser.add_argument(
+        "--lease-namespace",
+        default="",
+        help="Lease namespace (defaults to --namespace)",
+    )
     args = parser.parse_args(argv)
     if args.policy_cr and args.policy_file:
         parser.error("--policy-cr and --policy-file are mutually exclusive")
@@ -611,6 +746,9 @@ def main(argv: Optional[list[str]] = None) -> None:
             metrics_port=args.metrics_port,
             policy_ref=policy_ref,
             watch=args.watch,
+            leader_elect=args.leader_elect,
+            lease_name=args.lease_name,
+            lease_namespace=args.lease_namespace or None,
         ),
     )
     signal.signal(signal.SIGTERM, controller.stop)

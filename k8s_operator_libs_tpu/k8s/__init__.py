@@ -40,3 +40,7 @@ from k8s_operator_libs_tpu.k8s.rest import (  # noqa: F401
     get_default_client,
 )
 from k8s_operator_libs_tpu.k8s.apiserver import KubeApiServer  # noqa: F401
+from k8s_operator_libs_tpu.k8s.leader import (  # noqa: F401
+    LeaderElector,
+    ensure_lease_kind,
+)

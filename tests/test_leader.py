@@ -1,0 +1,353 @@
+"""Leader election over the coordination.k8s.io/v1 Lease surface.
+
+The reference's consumers get HA from controller-runtime's manager
+(client-go leaderelection); here the same protocol is proven on both
+tiers: FakeCluster CRUD and the full HTTP wire, with apiserver
+optimistic concurrency as the arbiter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.k8s import (
+    FakeCluster,
+    KubeApiServer,
+    KubeConfig,
+    RestClient,
+)
+from k8s_operator_libs_tpu.k8s.client import ConflictError, NotFoundError
+from k8s_operator_libs_tpu.k8s.leader import (
+    LEASE_GROUP,
+    LEASE_PLURAL,
+    LEASE_VERSION,
+    LeaderElector,
+    ensure_lease_kind,
+)
+
+NS = "kube-system"
+
+
+def _clocked(cluster, identity, clock, **kw):
+    kw.setdefault("lease_duration_s", 15.0)
+    kw.setdefault("renew_deadline_s", 10.0)
+    return LeaderElector(
+        cluster,
+        identity=identity,
+        namespace=NS,
+        time_fn=lambda: clock["t"],
+        mono_fn=lambda: clock["t"],
+        **kw,
+    )
+
+
+def _lease(cluster):
+    return cluster.get_custom_object(
+        LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, NS,
+        "tpu-upgrade-controller",
+    )
+
+
+def test_renew_deadline_must_precede_lease_duration():
+    with pytest.raises(ValueError):
+        LeaderElector(
+            FakeCluster(), lease_duration_s=10.0, renew_deadline_s=10.0
+        )
+
+
+def test_acquire_creates_lease_and_holds():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    assert a.acquire_or_renew()
+    assert a.is_leader()
+    spec = _lease(cluster)["spec"]
+    assert spec["holderIdentity"] == "a"
+    assert spec["leaseDurationSeconds"] == 15
+    assert spec["leaseTransitions"] == 0
+
+
+def test_subsecond_duration_never_advertises_zero():
+    """A 0.6 s test-scale term must advertise leaseDurationSeconds=1 —
+    0 reads as "unset" to observers, who would substitute their own
+    configured duration (wrong expiry in mixed-config fleets)."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(
+        cluster, "a", clock, lease_duration_s=0.6, renew_deadline_s=0.3
+    )
+    assert a.acquire_or_renew()
+    assert _lease(cluster)["spec"]["leaseDurationSeconds"] == 1
+
+
+def test_live_term_blocks_other_candidates_until_expiry():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    b = _clocked(cluster, "b", clock)
+    assert a.acquire_or_renew()
+    assert not b.acquire_or_renew()  # b first observes the term at t=0
+    clock["t"] = 10.0
+    assert a.acquire_or_renew()  # renewal
+    assert not b.acquire_or_renew()  # observed renewal at t=10
+    # b's expiry clock runs from ITS last observation (t=10) — clock-skew
+    # robustness: the holder's timestamps are never trusted directly.
+    clock["t"] = 24.0
+    assert not b.acquire_or_renew()
+    clock["t"] = 25.1
+    assert b.acquire_or_renew()
+    spec = _lease(cluster)["spec"]
+    assert spec["holderIdentity"] == "b"
+    assert spec["leaseTransitions"] == 1
+    # a discovers the takeover on its next round and stands down.
+    assert not a.acquire_or_renew()
+    assert not a.is_leader()
+
+
+def test_cas_conflict_means_not_leader_this_round():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    assert a.acquire_or_renew()
+    real_update = cluster.update_custom_object
+    calls = {"n": 0}
+
+    def flaky(*args, **kw):
+        calls["n"] += 1
+        raise ConflictError("simulated concurrent writer")
+
+    cluster.update_custom_object = flaky
+    try:
+        clock["t"] = 5.0
+        assert not a.acquire_or_renew()
+        assert not a.is_leader()
+        assert calls["n"] == 1
+    finally:
+        cluster.update_custom_object = real_update
+    # The next clean round re-acquires (its own lease, still unexpired →
+    # renewal path, no transition bump).
+    assert a.acquire_or_renew()
+    assert _lease(cluster)["spec"]["leaseTransitions"] == 0
+
+
+def test_create_race_loser_stands_down():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    b = _clocked(cluster, "b", clock)
+    real_get = cluster.get_custom_object
+
+    def stale_get(*args, **kw):
+        # b's view: no lease yet (cache/ordering) — while a creates it.
+        cluster.get_custom_object = real_get
+        _clocked(cluster, "a", clock).acquire_or_renew()
+        raise NotFoundError("leases tpu-upgrade-controller not found")
+
+    cluster.get_custom_object = stale_get
+    assert not b.acquire_or_renew()  # create conflicts → lost the race
+    assert _lease(cluster)["spec"]["holderIdentity"] == "a"
+
+
+def test_api_outage_stands_down_before_term_expires():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    assert a.acquire_or_renew()
+
+    def down(*args, **kw):
+        raise OSError("apiserver unreachable")
+
+    cluster.update_custom_object = down
+    cluster.get_custom_object = down
+    clock["t"] = 5.0
+    assert not a.acquire_or_renew()  # can't renew → act as non-leader
+    assert not a.is_leader()
+
+
+def test_is_leader_expires_at_renew_deadline_without_rounds():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    assert a.acquire_or_renew()
+    clock["t"] = 9.9
+    assert a.is_leader()
+    clock["t"] = 10.1  # renew_deadline 10 s with no successful renewal
+    assert not a.is_leader()
+
+
+def test_release_hands_over_immediately():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    b = _clocked(cluster, "b", clock)
+    assert a.acquire_or_renew()
+    assert not b.acquire_or_renew()
+    a.release()
+    assert not a.is_leader()
+    assert _lease(cluster)["spec"]["holderIdentity"] == ""
+    clock["t"] = 0.5  # far inside what WAS a's term
+    assert b.acquire_or_renew()
+    assert _lease(cluster)["spec"]["leaseTransitions"] == 1
+
+
+def test_release_is_noop_after_takeover():
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    b = _clocked(cluster, "b", clock)
+    assert a.acquire_or_renew()
+    assert not b.acquire_or_renew()  # b observes a's term at t=0
+    clock["t"] = 15.2  # a's term lapsed on b's own clock
+    assert b.acquire_or_renew()
+    a.release()  # must NOT clear b's term
+    assert _lease(cluster)["spec"]["holderIdentity"] == "b"
+
+
+def test_election_over_the_wire_tier():
+    """Same protocol through RestClient → HTTP → KubeApiServer: the CAS
+    arbiter is the server, and both clients contend on equal terms."""
+    store = FakeCluster()
+    ensure_lease_kind(store)
+    server = KubeApiServer(store)
+    server.start()
+    try:
+        rest = RestClient(KubeConfig(host=server.host), timeout_s=10.0)
+        clock = {"t": 0.0}
+        a = _clocked(rest, "rest-a", clock)
+        b = _clocked(store, "store-b", clock)
+        assert a.acquire_or_renew()
+        assert not b.acquire_or_renew()
+        spec = _lease(store)["spec"]
+        assert spec["holderIdentity"] == "rest-a"
+        a.release()
+        assert b.acquire_or_renew()
+        assert _lease(store)["spec"]["holderIdentity"] == "store-b"
+    finally:
+        server.stop()
+
+
+# --- controller integration -------------------------------------------------
+
+
+def _ha_controller(cluster, identity):
+    c = UpgradeController(
+        cluster,
+        ControllerConfig(
+            namespace=NS,
+            interval_s=0.05,
+            leader_elect=True,
+            identity=identity,
+            publish_events=False,
+        ),
+    )
+    # Election timings scaled for the test: term 0.6 s, stand-down 0.3 s,
+    # retry 0.03 s.
+    from k8s_operator_libs_tpu.k8s.leader import LeaderElector
+
+    c.elector = LeaderElector(
+        cluster,
+        identity=identity,
+        namespace=NS,
+        lease_duration_s=0.6,
+        renew_deadline_s=0.3,
+        retry_period_s=0.03,
+    )
+    return c
+
+
+def test_only_the_leader_reconciles_and_failover_works():
+    """Two replicas: exactly one reconciles; stopping it (clean release)
+    fails over to the standby within the retry period."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    c1 = _ha_controller(cluster, "replica-1")
+    c2 = _ha_controller(cluster, "replica-2")
+    counts = {"replica-1": 0, "replica-2": 0}
+
+    def spy(c, name):
+        orig = c.reconcile_once
+
+        def counted():
+            counts[name] += 1
+            return orig()
+
+        c.reconcile_once = counted
+
+    spy(c1, "replica-1")
+    spy(c2, "replica-2")
+    t1 = threading.Thread(target=c1.run_forever, daemon=True)
+    t1.start()
+    deadline = time.monotonic() + 5.0
+    while counts["replica-1"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert counts["replica-1"] > 0, "first replica never led"
+    t2 = threading.Thread(target=c2.run_forever, daemon=True)
+    t2.start()
+    time.sleep(0.3)
+    assert counts["replica-2"] == 0, "standby reconciled while leader held"
+    assert _lease(cluster)["spec"]["holderIdentity"] == "replica-1"
+    # Failover: clean stop releases the lease; the standby takes over.
+    c1.stop()
+    t1.join(5.0)
+    assert not t1.is_alive()
+    deadline = time.monotonic() + 5.0
+    while counts["replica-2"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    c2.stop()
+    t2.join(5.0)
+    assert not t2.is_alive()
+    assert counts["replica-2"] > 0, "standby never took over after release"
+    assert _lease(cluster)["spec"]["holderIdentity"] in ("replica-2", "")
+    # The leadership gauge reflects each replica's final view.
+    rendered = c2.registry.render()
+    assert "tpu_upgrade_controller_is_leader" in rendered
+
+
+def test_crashed_leader_fails_over_after_lease_expiry():
+    """A leader that dies WITHOUT releasing (kill -9) is replaced once
+    its term lapses — no manual intervention."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    # Simulated dead leader: a lease it will never renew again.
+    dead = LeaderElector(
+        cluster,
+        identity="dead-leader",
+        namespace=NS,
+        lease_duration_s=0.4,
+        renew_deadline_s=0.2,
+    )
+    assert dead.acquire_or_renew()
+    c2 = _ha_controller(cluster, "replica-2")
+    # Match the dead leader's advertised duration: the standby waits
+    # out leaseDurationSeconds from its first observation.
+    counts = {"n": 0}
+    orig = c2.reconcile_once
+
+    def counted():
+        counts["n"] += 1
+        return orig()
+
+    c2.reconcile_once = counted
+    t2 = threading.Thread(target=c2.run_forever, daemon=True)
+    t2.start()
+    deadline = time.monotonic() + 5.0
+    while counts["n"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    c2.stop()
+    t2.join(5.0)
+    assert counts["n"] > 0, "standby never took over from the dead leader"
+    # stop() releases replica-2's own term, so the holder is either the
+    # standby (release raced the join) or already cleared.
+    assert _lease(cluster)["spec"]["holderIdentity"] in ("replica-2", "")
