@@ -178,6 +178,9 @@ class UpgradeController:
         # Election bookkeeping (leader_elect mode).
         self._last_election_at: Optional[float] = None
         self._was_leader = False
+        # Set in run_forever when watch + leader-elect are both on: the
+        # watch pump streams only while this Event is set (leading).
+        self._pump_gate: Optional[threading.Event] = None
 
     def reconcile_once(self) -> bool:
         """One full pass; returns False when the snapshot was incoherent
@@ -454,8 +457,14 @@ class UpgradeController:
             self._wake.set()  # interrupt a watch-mode resync wait
 
     def _still_leading(self) -> bool:
-        """Mid-pass leadership guard; True when not in leader-elect mode."""
-        if self.elector is None or self.elector.is_leader():
+        """Mid-pass leadership guard; True when not in leader-elect mode.
+
+        Runs a (retry-period-throttled) election round rather than only
+        reading the deadline: a pass that takes longer than the renew
+        deadline RENEWS here and proceeds — without this, every slow
+        pass would abort at the guard, renew at the top of the loop, and
+        abort again, livelocking a large cluster."""
+        if self.elector is None or self._election_round():
             return True
         logger.warning(
             "leadership lost mid-pass (identity=%s); aborting reconcile",
@@ -475,12 +484,17 @@ class UpgradeController:
         if (
             self._last_election_at is None
             or now - self._last_election_at >= e.retry_period_s
-            or not e.is_leader()
+            # A HOLDER whose deadline decayed mid-wait renews at once
+            # (the slow-pass guard).  A standby must NOT bypass the
+            # throttle — `not is_leader()` is always true for it, and
+            # _wait's 0.2 s chunks would turn the stated retry cadence
+            # into ~5 Lease GETs per second per replica.
+            or (self._was_leader and not e.is_leader())
         ):
             self._last_election_at = now
             leading = e.acquire_or_renew()
         else:
-            leading = True
+            leading = self._was_leader
         self.registry.set(
             "tpu_upgrade_controller_is_leader",
             1.0 if leading else 0.0,
@@ -494,7 +508,46 @@ class UpgradeController:
                 e.identity,
             )
         self._was_leader = leading
+        if self._pump_gate is not None:
+            if leading:
+                self._pump_gate.set()
+            else:
+                self._pump_gate.clear()
         return leading
+
+    def _wait(
+        self,
+        duration: float,
+        wake: Optional[threading.Event] = None,
+    ) -> bool:
+        """Sleep up to ``duration``, chunked so ``stop()`` interrupts
+        promptly, the leader keeps renewing its lease (a reconcile
+        interval must never starve the renew deadline), and a leadership
+        change in EITHER direction ends the wait early (the caller's
+        loop re-evaluates).  Returns True iff ``wake`` fired (a watch
+        event)."""
+        deadline = time.monotonic() + duration
+        e = self.elector
+        was = self._was_leader
+        while not self._stop:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            if wake is not None:
+                chunk = (
+                    remaining
+                    if e is None
+                    else min(remaining, e.retry_period_s)
+                )
+                if wake.wait(chunk):
+                    return True
+            else:
+                time.sleep(min(remaining, 0.2))
+            if e is not None:
+                self._election_round()
+                if self._was_leader != was:
+                    return False
+        return False
 
     def _watch_kinds(self) -> list[str]:
         kinds = ["Node", "Pod", "DaemonSet"]
@@ -513,12 +566,24 @@ class UpgradeController:
 
     def _watch_pump(self, wake: threading.Event) -> None:
         """Background thread: any watch event sets the wake flag; the
-        stream is re-established on errors (apiserver restarts)."""
+        stream is re-established on errors (apiserver restarts).
+
+        Under leader election the pump holds streams only while this
+        replica leads (controller-runtime starts informers after winning
+        the election): a standby discards every event anyway, and on a
+        large pool the Pod watch is a heavy stream the apiserver should
+        not carry twice."""
         while not self._stop:
+            gate = self._pump_gate
+            if gate is not None and not gate.is_set():
+                gate.wait(0.5)
+                continue
             try:
                 for ev in self.client.watch_events(self._watch_kinds()):
                     if self._stop:
                         return
+                    if gate is not None and not gate.is_set():
+                        break  # lost leadership: drop the streams
                     if ev is not None:
                         wake.set()
             except Exception as e:  # noqa: BLE001 — reconnect, don't die
@@ -534,6 +599,8 @@ class UpgradeController:
         if self.config.watch:
             wake = threading.Event()
             self._wake = wake
+            if self.elector is not None:
+                self._pump_gate = threading.Event()
             threading.Thread(
                 target=self._watch_pump, args=(wake,), daemon=True
             ).start()
@@ -549,12 +616,9 @@ class UpgradeController:
             while not self._stop:
                 if self.elector is not None and not self._election_round():
                     # Standby: never reconcile without the lease; retry
-                    # at the election cadence.
-                    deadline = (
-                        time.monotonic() + self.elector.retry_period_s
-                    )
-                    while not self._stop and time.monotonic() < deadline:
-                        time.sleep(0.05)
+                    # at the election cadence (the wait ends early on
+                    # gaining leadership).
+                    self._wait(self.elector.retry_period_s)
                     continue
                 if wake is not None:
                     # Clear BEFORE reconciling: an event that lands
@@ -564,40 +628,12 @@ class UpgradeController:
                     self.reconcile_once()
                 except Exception:  # noqa: BLE001 — loop must survive
                     logger.exception("reconcile pass failed")
-                if wake is not None:
-                    # Event-driven: wake on the first change, or resync
-                    # after the full interval.  Chunked so a leader keeps
-                    # renewing its lease while idle; losing it aborts the
-                    # wait (the top of the loop goes standby).
-                    deadline = time.monotonic() + self.config.interval_s
-                    woken = False
-                    while not self._stop and not woken:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            break
-                        chunk = (
-                            min(remaining, self.elector.retry_period_s)
-                            if self.elector is not None
-                            else remaining
-                        )
-                        woken = wake.wait(chunk)
-                        if (
-                            self.elector is not None
-                            and not self._election_round()
-                        ):
-                            woken = False
-                            break
-                    if woken and self.config.watch_debounce_s > 0:
-                        time.sleep(self.config.watch_debounce_s)
-                    continue
-                deadline = time.monotonic() + self.config.interval_s
-                while not self._stop and time.monotonic() < deadline:
-                    time.sleep(0.2)
-                    if (
-                        self.elector is not None
-                        and not self._election_round()
-                    ):
-                        break
+                # Event-driven: wake on the first change; otherwise the
+                # interval is the (resync) cadence.  Losing leadership
+                # ends the wait and the top of the loop goes standby.
+                woken = self._wait(self.config.interval_s, wake)
+                if woken and self.config.watch_debounce_s > 0:
+                    time.sleep(self.config.watch_debounce_s)
         finally:
             if self.elector is not None:
                 # Clean shutdown hands the lease over immediately instead

@@ -133,17 +133,23 @@ class LeaderElector:
     def acquire_or_renew(self) -> bool:
         """One election round; True iff this process holds the lease.
 
-        Network/API errors never raise — they report False (stand down)
-        and the next round retries."""
+        Network/API errors never raise.  For a current holder they fall
+        back to the renew-deadline grace (``is_leader()``): one transient
+        apiserver error must not abort in-flight work while the Lease
+        still names this process; only a deadline's worth of consecutive
+        failures stands it down.  For a candidate they report False."""
         try:
             result = self._try_acquire_or_renew()
             self._last_error = None
             return result
         except ConflictError:
-            # Lost a CAS race (a concurrent candidate won the write):
-            # normal contention, retry next round.
-            self._is_leader = False
-            return False
+            # A concurrent writer won this round's CAS.  A holder keeps
+            # acting until its renew DEADLINE (client-go retries renewal
+            # until renewDeadline — one contended write must not flap
+            # leadership); the next round re-reads the lease, and a
+            # genuine takeover is observed there and stands us down
+            # immediately.  A candidate that never held simply lost.
+            return self.is_leader()
         except NotFoundError as e:
             # Either the lease vanished mid-flight (transient — next
             # round recreates it) or the Lease surface itself is
@@ -159,19 +165,22 @@ class LeaderElector:
                     self.namespace, self.name, e,
                 )
                 self._last_error = str(e)
-            self._is_leader = False
-            return False
+            return self.is_leader()
         except Exception as e:  # noqa: BLE001 — election must not crash the loop
+            # Transient apiserver error: same deadline grace as above — a
+            # single timeout must not abort an in-flight reconcile while
+            # the Lease still names this process.
             logger.warning("leader election round failed: %s", e)
-            self._is_leader = False
-            return False
+            return self.is_leader()
 
     def release(self) -> None:
         """Voluntarily end the term (clean shutdown): clear the holder so
         a successor acquires immediately instead of waiting out the
-        lease.  Best-effort."""
-        if not self._is_leader:
-            return
+        lease.  Best-effort.  Attempted whenever this process ever held
+        the lease — even if a renewal blip cleared the local flag — the
+        holder check below protects a successor's term."""
+        if self._last_renew is None:
+            return  # never held
         self._is_leader = False
         try:
             lease = self.client.get_custom_object(

@@ -61,6 +61,18 @@ CONTROLLER_RBAC_RULES: list[dict[str, Any]] = [
     },
 ]
 
+# Namespaced (Role, not ClusterRole): leader election touches exactly one
+# Lease in the install namespace — a cluster-wide lease grant would let a
+# compromised controller pod rewrite kube-node-lease heartbeats or hijack
+# other components' elections.
+CONTROLLER_NAMESPACED_RULES: list[dict[str, Any]] = [
+    {
+        "apiGroups": ["coordination.k8s.io"],
+        "resources": ["leases"],
+        "verbs": ["get", "create", "update"],
+    },
+]
+
 # Driver safe-load init containers and per-host agents only read their
 # own Node and patch annotations on it.
 NODE_REPORTER_RBAC_RULES: list[dict[str, Any]] = [
@@ -82,6 +94,35 @@ def _cluster_role(name: str, rules: list[dict]) -> dict:
         "kind": "ClusterRole",
         "metadata": {"name": name},
         "rules": rules,
+    }
+
+
+def _role(name: str, namespace: str, rules: list[dict]) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": {"name": name, "namespace": namespace},
+        "rules": rules,
+    }
+
+
+def _role_binding(name: str, sa: str, namespace: str) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {"name": name, "namespace": namespace},
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": name,
+        },
+        "subjects": [
+            {
+                "kind": "ServiceAccount",
+                "name": sa,
+                "namespace": namespace,
+            }
+        ],
     }
 
 
@@ -110,10 +151,13 @@ def controller_deployment(
     image: str,
     policy_cr: Optional[str] = None,
 ) -> dict:
-    """Single-replica controller Deployment.  One replica is correct, not
-    a limitation: all state lives in cluster labels, passes are
-    idempotent, and two concurrent controllers would only race benignly
-    (chaos tier), but a second replica buys nothing."""
+    """Two-replica controller Deployment under leader election.
+
+    All state lives in cluster labels and passes are idempotent, so even
+    concurrent controllers only race benignly (chaos tier) — but the
+    Lease keeps exactly one replica reconciling while the standby buys
+    fast failover (clean shutdown releases the lease; a crash hands over
+    after the term lapses)."""
     args = [
         "--namespace",
         namespace,
@@ -121,6 +165,7 @@ def controller_deployment(
         "--manage-agent",
         "--metrics-port",
         "8081",
+        "--leader-elect",
     ]
     if policy_cr:
         args += ["--policy-cr", policy_cr]
@@ -133,7 +178,7 @@ def controller_deployment(
             "labels": {"app": CONTROLLER_NAME},
         },
         "spec": {
-            "replicas": 1,
+            "replicas": 2,
             "selector": {"matchLabels": {"app": CONTROLLER_NAME}},
             "template": {
                 "metadata": {"labels": {"app": CONTROLLER_NAME}},
@@ -177,6 +222,8 @@ def controller_manifests(
         _service_account(CONTROLLER_NAME, namespace),
         _cluster_role(CONTROLLER_NAME, CONTROLLER_RBAC_RULES),
         _cluster_role_binding(CONTROLLER_NAME, CONTROLLER_NAME, namespace),
+        _role(CONTROLLER_NAME, namespace, CONTROLLER_NAMESPACED_RULES),
+        _role_binding(CONTROLLER_NAME, CONTROLLER_NAME, namespace),
         _service_account(NODE_REPORTER_NAME, namespace),
         _cluster_role(NODE_REPORTER_NAME, NODE_REPORTER_RBAC_RULES),
         _cluster_role_binding(
@@ -198,6 +245,7 @@ _KIND_TO_RESOURCE = {
     "controllerrevisions": ("apps", "controllerrevisions"),
     POLICY_PLURAL: (POLICY_GROUP, POLICY_PLURAL),
     f"{POLICY_PLURAL}/status": (POLICY_GROUP, f"{POLICY_PLURAL}/status"),
+    "leases": ("coordination.k8s.io", "leases"),
 }
 
 _METHOD_TO_VERBS = {

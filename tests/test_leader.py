@@ -111,7 +111,9 @@ def test_live_term_blocks_other_candidates_until_expiry():
     assert not a.is_leader()
 
 
-def test_cas_conflict_means_not_leader_this_round():
+def test_cas_conflict_keeps_holder_until_renew_deadline():
+    """client-go grace: one contended write must not flap leadership —
+    the holder keeps acting until the renew deadline, then stands down."""
     cluster = FakeCluster()
     ensure_lease_kind(cluster)
     clock = {"t": 0.0}
@@ -126,10 +128,13 @@ def test_cas_conflict_means_not_leader_this_round():
 
     cluster.update_custom_object = flaky
     try:
-        clock["t"] = 5.0
+        clock["t"] = 5.0  # inside the 10 s renew deadline
+        assert a.acquire_or_renew()
+        assert a.is_leader()
+        assert calls["n"] == 1
+        clock["t"] = 10.1  # deadline's worth of failed renewals
         assert not a.acquire_or_renew()
         assert not a.is_leader()
-        assert calls["n"] == 1
     finally:
         cluster.update_custom_object = real_update
     # The next clean round re-acquires (its own lease, still unexpired →
@@ -157,6 +162,9 @@ def test_create_race_loser_stands_down():
 
 
 def test_api_outage_stands_down_before_term_expires():
+    """Grace, then safety: a holder rides out transient outages until
+    the renew deadline (10 s), but stands down BEFORE its 15 s term
+    expires for any observer — no moment with two actors."""
     cluster = FakeCluster()
     ensure_lease_kind(cluster)
     clock = {"t": 0.0}
@@ -169,7 +177,10 @@ def test_api_outage_stands_down_before_term_expires():
     cluster.update_custom_object = down
     cluster.get_custom_object = down
     clock["t"] = 5.0
-    assert not a.acquire_or_renew()  # can't renew → act as non-leader
+    assert a.acquire_or_renew()  # outage within deadline: keep acting
+    assert a.is_leader()
+    clock["t"] = 10.1  # deadline passed, term (15 s) not yet — stand down
+    assert not a.acquire_or_renew()
     assert not a.is_leader()
 
 
@@ -313,6 +324,53 @@ def test_only_the_leader_reconciles_and_failover_works():
     # The leadership gauge reflects each replica's final view.
     rendered = c2.registry.render()
     assert "tpu_upgrade_controller_is_leader" in rendered
+
+
+def test_slow_pass_renews_at_the_midpass_guard_instead_of_livelocking():
+    """A reconcile pass that outlives the renew deadline must RENEW at
+    the pre-apply_state guard and proceed — not abort, renew at the top
+    of the loop, and abort again forever."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    c = _ha_controller(cluster, "replica-1")
+    assert c._election_round()
+    time.sleep(0.35)  # past the 0.3 s renew deadline: is_leader decayed
+    assert not c.elector.is_leader()
+    assert c._still_leading()  # guard renews (due) and the pass proceeds
+    assert c.elector.is_leader()
+
+
+def test_standby_watch_pump_holds_no_streams():
+    """Under watch + leader election only the leader's pump streams; a
+    standby must not double the apiserver's watch load for events it
+    discards."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    # Occupy the lease so both controllers below are standbys.
+    blocker = LeaderElector(cluster, identity="blocker", namespace=NS)
+    assert blocker.acquire_or_renew()
+    c = _ha_controller(cluster, "replica-1")
+    c.config.watch = True
+    t = threading.Thread(target=c.run_forever, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while c._pump_gate is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert c._pump_gate is not None
+        time.sleep(0.3)  # give a (wrongly eager) pump time to subscribe
+        assert not c._pump_gate.is_set()
+        assert not cluster._watchers, "standby pump opened watch streams"
+        # Leadership arrives → the pump starts streaming.
+        blocker.release()
+        deadline = time.monotonic() + 5.0
+        while not cluster._watchers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cluster._watchers, "leader pump never opened streams"
+    finally:
+        c.stop()
+        t.join(5.0)
+    assert not t.is_alive()
 
 
 def test_crashed_leader_fails_over_after_lease_expiry():

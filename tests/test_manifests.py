@@ -26,6 +26,7 @@ from k8s_operator_libs_tpu.k8s import (
     RestClient,
 )
 from k8s_operator_libs_tpu.manifests import (
+    CONTROLLER_NAMESPACED_RULES,
     CONTROLLER_NAME,
     CONTROLLER_RBAC_RULES,
     NODE_REPORTER_NAME,
@@ -35,6 +36,7 @@ from k8s_operator_libs_tpu.manifests import (
     rule_grants,
     uncovered,
 )
+from k8s_operator_libs_tpu.k8s.leader import LeaderElector, ensure_lease_kind
 from k8s_operator_libs_tpu.upgrade import UpgradeKeys
 from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
 
@@ -48,19 +50,33 @@ def test_manifest_shapes():
         "ServiceAccount",
         "ClusterRole",
         "ClusterRoleBinding",
+        "Role",
+        "RoleBinding",
         "ServiceAccount",
         "ClusterRole",
         "ClusterRoleBinding",
         "Deployment",
     ]
     names = [d["metadata"]["name"] for d in docs]
-    assert names.count(CONTROLLER_NAME) == 4  # SA, role, binding, deploy
+    # SA, ClusterRole, CRB, Role, RoleBinding, Deployment
+    assert names.count(CONTROLLER_NAME) == 6
+    # The lease grant is namespaced (Role), never cluster-wide: a
+    # cluster-scoped lease write could rewrite node heartbeats.
+    role = docs[3]
+    assert role["kind"] == "Role"
+    assert role["metadata"]["namespace"] == "tpu-system"
+    assert role["rules"] == CONTROLLER_NAMESPACED_RULES
+    assert not any(
+        "leases" in r.get("resources", []) for r in CONTROLLER_RBAC_RULES
+    )
     assert names.count(NODE_REPORTER_NAME) == 3
     deploy = docs[-1]
-    assert deploy["spec"]["replicas"] == 1
+    # Two replicas under leader election: standby buys fast failover.
+    assert deploy["spec"]["replicas"] == 2
     tmpl = deploy["spec"]["template"]["spec"]
     assert tmpl["serviceAccountName"] == CONTROLLER_NAME
     assert tmpl["containers"][0]["image"] == "img:1"
+    assert "--leader-elect" in tmpl["containers"][0]["args"]
     binding = docs[2]
     assert binding["subjects"][0]["namespace"] == "tpu-system"
 
@@ -111,6 +127,10 @@ def roll_stats():
     write-back) plus a DaemonSet create + template-update reconcile."""
     store = FakeCluster()
     register_policy_crd(store)
+    # Server-side Lease registration (a real apiserver serves
+    # coordination.k8s.io natively; ensure_lease_kind through RestClient
+    # is deliberately a no-op).
+    ensure_lease_kind(store)
     keys = UpgradeKeys()
     fx = ClusterFixture(store, keys)
     ds = fx.daemon_set(hash_suffix="v1", revision=1)
@@ -154,7 +174,19 @@ def roll_stats():
                 policy=None,
                 policy_ref=(NAMESPACE, "rollout"),
                 hbm_floor_fraction=0.0,
+                leader_elect=True,
+                identity="manifest-roll",
             ),
+        )
+        # retry_period 0: every round renews, so the recorded traffic
+        # contains lease get+create+update — the verbs RBAC grants.
+        controller.elector = LeaderElector(
+            client,
+            identity="manifest-roll",
+            namespace=NAMESPACE,
+            lease_duration_s=5.0,
+            renew_deadline_s=3.0,
+            retry_period_s=0.0,
         )
         controller.manager.with_pod_deletion_enabled(
             lambda p: not p.is_daemonset_pod()
@@ -162,6 +194,7 @@ def roll_stats():
         controller.manager.provider.poll_interval_s = 0.01
         controller.manager.provider.poll_timeout_s = 2.0
         for _ in range(40):
+            assert controller._election_round()
             controller.reconcile_once()
             controller.manager.wait_for_async_work(10.0)
             states = {
@@ -180,8 +213,9 @@ def roll_stats():
 
 def test_controller_rbac_covers_a_full_roll_on_the_wire(roll_stats):
     """Forward direction: every wire verb the engine issued is granted."""
-    assert not uncovered(roll_stats.keys(), CONTROLLER_RBAC_RULES), uncovered(
-        roll_stats.keys(), CONTROLLER_RBAC_RULES
+    all_rules = CONTROLLER_RBAC_RULES + CONTROLLER_NAMESPACED_RULES
+    assert not uncovered(roll_stats.keys(), all_rules), uncovered(
+        roll_stats.keys(), all_rules
     )
     # The roll must actually have exercised the interesting surface, or
     # the coverage claim is vacuous.
@@ -194,6 +228,7 @@ def test_controller_rbac_covers_a_full_roll_on_the_wire(roll_stats):
         "controllerrevisions",
         POLICY_PLURAL,
         f"{POLICY_PLURAL}/status",
+        "leases",
     } <= kinds, kinds
     # And no stat key is unmapped (required_grants raises on unknowns).
     required_grants(roll_stats.keys())
@@ -210,7 +245,9 @@ def test_no_unused_controller_grants(roll_stats):
             observed.add((group, resource, verb))
     over_privileged = [
         grant
-        for grant in sorted(rule_grants(CONTROLLER_RBAC_RULES))
+        for grant in sorted(
+            rule_grants(CONTROLLER_RBAC_RULES + CONTROLLER_NAMESPACED_RULES)
+        )
         if grant not in observed
     ]
     assert not over_privileged, over_privileged
