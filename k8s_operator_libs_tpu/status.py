@@ -116,6 +116,29 @@ def gather(
     }
     if policy_section is not None:
         out["policy"] = policy_section
+    # Who is driving: the election Lease names the active controller
+    # replica (empty/absent = single-replica mode or between terms).
+    try:
+        from k8s_operator_libs_tpu.k8s.client import NotFoundError
+        from k8s_operator_libs_tpu.k8s.leader import (
+            LEASE_GROUP,
+            LEASE_PLURAL,
+            LEASE_VERSION,
+        )
+
+        lease = client.get_custom_object(
+            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, namespace,
+            "tpu-upgrade-controller",
+        )
+        spec = lease.get("spec") or {}
+        out["leader"] = {
+            "holder": spec.get("holderIdentity") or "",
+            "renewTime": spec.get("renewTime") or "",
+        }
+    except NotFoundError:
+        pass
+    except Exception:  # noqa: BLE001 — read-only nicety, never fail status
+        pass
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -157,6 +180,13 @@ def render(status: dict) -> str:
         lines.append(
             f"{g['group'][:32]:32s} {g['state']:24s} {g['hosts']:>5d} "
             f"{g['unavailable']:>7d} {g['topology']:10s} {g['dcn_group']}"
+        )
+    leader = status.get("leader")
+    if leader is not None:
+        lines.append("")
+        lines.append(
+            f"leader: {leader['holder'] or '(none — between terms)'} "
+            f"(renewed {leader['renewTime']})"
         )
     policy = status.get("policy")
     if policy is not None:

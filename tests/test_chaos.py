@@ -188,3 +188,116 @@ def test_partial_label_write_resolves_forward():
             break
     else:
         pytest.fail(f"never converged: {states}")
+
+
+def test_ha_replicas_converge_through_faults_with_single_driver():
+    """Two leader-elected replicas under an injected-fault apiserver:
+    the roll converges, and at no point do both replicas drive a
+    mutating pass concurrently (the split-brain invariant, observed via
+    instrumented apply_state)."""
+    import threading
+    import time as _time
+
+    from k8s_operator_libs_tpu.controller import (
+        ControllerConfig,
+        UpgradeController,
+    )
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+    from tests.test_upgrade_state import FakeProber
+
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    keys = UpgradeKeys(driver_name="libtpu")
+    nodes = _upgrade_scenario(cluster, keys)
+    rng = random.Random(7)
+
+    def flaky(verb: str) -> None:
+        # Never fault the fixture's DS-controller emulation, and never
+        # the lease CAS verbs — we are testing the ENGINE through
+        # faults; election robustness has its own tier.
+        if verb.startswith(("create_pod", "get_custom", "update_custom",
+                            "create_custom")):
+            return
+        if rng.random() < 0.05:
+            raise RuntimeError(f"injected apiserver fault on {verb}")
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    in_apply = threading.Semaphore(1)
+    overlap = []
+
+    def make(identity):
+        c = UpgradeController(
+            cluster,
+            ControllerConfig(
+                namespace=NAMESPACE,
+                driver_labels=DRIVER_LABELS,
+                driver_name="libtpu",
+                interval_s=0.02,
+                policy=policy,
+                leader_elect=True,
+                identity=identity,
+                publish_events=False,
+            ),
+        )
+        c.elector = LeaderElector(
+            cluster,
+            identity=identity,
+            namespace=NAMESPACE,
+            lease_duration_s=0.8,
+            renew_deadline_s=0.4,
+            retry_period_s=0.05,
+        )
+        c.manager.validation_manager.prober = FakeProber()
+        c.manager.provider.poll_interval_s = 0.01
+        c.manager.provider.poll_timeout_s = 2.0
+        orig_apply = c.manager.apply_state
+
+        def guarded_apply(state, pol):
+            if not in_apply.acquire(blocking=False):
+                overlap.append(identity)
+                return
+            try:
+                return orig_apply(state, pol)
+            finally:
+                in_apply.release()
+
+        c.manager.apply_state = guarded_apply
+        return c
+
+    c1, c2 = make("replica-1"), make("replica-2")
+    cluster.fault_injector = flaky
+    t1 = threading.Thread(target=c1.run_forever, daemon=True)
+    t2 = threading.Thread(target=c2.run_forever, daemon=True)
+    t1.start()
+    t2.start()
+    try:
+        deadline = _time.monotonic() + 120
+        states = {}
+        while _time.monotonic() < deadline:
+            with contextlib.suppress(RuntimeError):
+                states = {
+                    n.name: cluster.get_node(
+                        n.name, cached=False
+                    ).labels.get(keys.state_label, "")
+                    for n in nodes
+                }
+                if all(s == "upgrade-done" for s in states.values()):
+                    break
+            _time.sleep(0.05)
+        else:
+            pytest.fail(f"HA roll never converged: {states}")
+    finally:
+        cluster.fault_injector = None
+        c1.stop()
+        c2.stop()
+        t1.join(10.0)
+        t2.join(10.0)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert not overlap, f"concurrent mutating passes by: {overlap}"

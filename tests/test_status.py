@@ -166,3 +166,60 @@ def test_status_cli_unused_policy_section_absent():
     cluster, keys = _mid_roll_cluster()
     status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
     assert "policy" not in status
+
+
+def test_status_shows_election_leader():
+    """With HA replicas the operator's first question is 'who is
+    driving' — the status surfaces the Lease holder."""
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+
+    cluster, keys = _mid_roll_cluster()
+    # No lease registered/held → no leader section, render still clean.
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert "leader" not in status
+    ensure_lease_kind(cluster)
+    elector = LeaderElector(
+        cluster, identity="replica-7", namespace=NAMESPACE
+    )
+    assert elector.acquire_or_renew()
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert status["leader"]["holder"] == "replica-7"
+    assert status["leader"]["renewTime"]
+    assert "leader: replica-7" in render(status)
+    # Released (between terms): holder shows as none.
+    elector.release()
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert status["leader"]["holder"] == ""
+    assert "(none — between terms)" in render(status)
+
+
+def test_status_cli_main_end_to_end(monkeypatch, capsys):
+    """python -m k8s_operator_libs_tpu.status --json against a stubbed
+    default client: the operator entry point, not just gather()."""
+    import pytest
+
+    from k8s_operator_libs_tpu import status as status_mod
+
+    cluster, _keys = _mid_roll_cluster()
+    monkeypatch.setattr(
+        "k8s_operator_libs_tpu.k8s.get_default_client",
+        lambda timeout_s=30.0: cluster,
+    )
+    status_mod.main(
+        ["--namespace", NAMESPACE, "--selector", "app=libtpu-driver",
+         "--policy-cr", f"{NAMESPACE}/rollout", "--json"]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert out["totalManagedGroups"] == 3
+    assert out["policy"]["spec"]["autoUpgrade"] is True
+    # Human rendering path.
+    status_mod.main(
+        ["--namespace", NAMESPACE, "--selector", "app=libtpu-driver"]
+    )
+    assert "GROUP" in capsys.readouterr().out
+    # Malformed --policy-cr is a usage error, not a traceback.
+    with pytest.raises(SystemExit):
+        status_mod.main(["--policy-cr", "missing-slash"])
