@@ -759,3 +759,165 @@ def test_apply_state_pushes_dcn_check_to_prober():
         ClusterUpgradeState(), DriverUpgradePolicySpec(auto_upgrade=True)
     )
     assert prober.require_dcn_check is False
+
+
+# --- defensive branches: forced failures --------------------------------
+
+
+def test_min_time_env_fallback(monkeypatch):
+    """A malformed K8S_TPU_PROBE_MIN_TIME_S must fall back to the 0.05
+    default, not crash every importer of the health package."""
+    from k8s_operator_libs_tpu.health import probes
+
+    monkeypatch.setenv("K8S_TPU_PROBE_MIN_TIME_S", "50ms")
+    assert probes._min_time_from_env() == 0.05
+    monkeypatch.setenv("K8S_TPU_PROBE_MIN_TIME_S", "")
+    assert probes._min_time_from_env() == 0.05
+    monkeypatch.setenv("K8S_TPU_PROBE_MIN_TIME_S", "0.2")
+    assert probes._min_time_from_env() == 0.2
+
+
+def test_ici_ring_detects_wrong_delivery(monkeypatch, cpu_devices):
+    """A ppermute that fails to move data must be reported as a NAMED bad
+    link, not a pass — this is the per-link attribution the probe exists
+    for."""
+    import jax.lax as lax
+
+    real = lax.ppermute
+    monkeypatch.setattr(
+        jax.lax, "ppermute", lambda x, axis_name, perm: x  # drops traffic
+    )
+    try:
+        res = ici_ring_probe(cpu_devices)
+    finally:
+        monkeypatch.setattr(jax.lax, "ppermute", real)
+    assert not res.ok
+    assert "delivered" in res.detail
+    assert res.metrics["bad_links"] >= 1
+
+
+def test_matmul_probe_reports_content_mismatch(monkeypatch, cpu_devices):
+    """A wrong chained-matmul value is a failing, attributable check."""
+    import numpy as _np
+
+    from k8s_operator_libs_tpu.health import probes
+
+    def fake(fn, args, **kw):
+        return 1.0, _np.full((4, 4), 0.75, _np.float32), 7
+
+    monkeypatch.setattr(probes, "_timed_sustained", fake)
+    res = probes.matmul_probe(cpu_devices[0], n=4)
+    assert not res.ok
+    assert "mismatch" in res.detail
+
+
+def test_hbm_probe_reports_content_mismatch(monkeypatch, cpu_devices):
+    from k8s_operator_libs_tpu.health import probes
+
+    import numpy as _np
+
+    def fake(fn, args, **kw):
+        return 1.0, _np.zeros((16,), _np.float32), 7  # expected 7.0
+
+    monkeypatch.setattr(probes, "_timed_sustained", fake)
+    res = probes.hbm_bandwidth_probe(cpu_devices[0], mib=1)
+    assert not res.ok
+    assert "mismatch" in res.detail
+
+
+def test_ring_attention_probe_failure_is_attributable(monkeypatch, cpu_devices):
+    import k8s_operator_libs_tpu.workloads.ring_attention as ra
+
+    monkeypatch.setattr(
+        ra, "ring_attention_soak",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("link down")),
+    )
+    from k8s_operator_libs_tpu.health.probes import ici_ring_attention_probe
+
+    res = ici_ring_attention_probe(cpu_devices)
+    assert not res.ok
+    assert "link down" in res.detail
+    assert ici_ring_attention_probe(cpu_devices[:1]).ok  # vacuous single
+
+
+# --- maybe_initialize_distributed decision table (in-process) -----------
+
+
+def _capture_init(monkeypatch, process_count=1):
+    from k8s_operator_libs_tpu.health import agent as agent_mod
+
+    calls = []
+    monkeypatch.setattr(
+        agent_mod.jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    monkeypatch.setattr(
+        agent_mod.jax, "process_count", lambda backend=None: process_count
+    )
+    for var in (
+        "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID",
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    return agent_mod, calls
+
+
+def test_distributed_init_gke_explicit_topology(monkeypatch):
+    agent_mod, calls = _capture_init(monkeypatch, process_count=2)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert agent_mod.maybe_initialize_distributed() is True
+    assert calls == [
+        {
+            "coordinator_address": f"h0:{agent_mod.GKE_COORDINATOR_PORT}",
+            "num_processes": 2,
+            "process_id": 1,
+            "cluster_detection_method": "deactivate",
+        }
+    ]
+
+
+def test_distributed_init_megascale_uses_auto_detection(monkeypatch):
+    """Per-slice TPU_WORKER_* env under megascale would compute a WRONG
+    global topology; jax's own detection must be used instead."""
+    agent_mod, calls = _capture_init(monkeypatch, process_count=4)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "dcn-coord:9999")
+    assert agent_mod.maybe_initialize_distributed() is True
+    assert calls == [{}]  # auto-detection; never the megascale address
+
+
+def test_distributed_init_explicit_coordinator_only(monkeypatch):
+    agent_mod, calls = _capture_init(monkeypatch, process_count=1)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "coord:1234")
+    assert agent_mod.maybe_initialize_distributed() is False
+    assert calls == [{}]  # single hostname: fall back to auto-detection
+
+
+def test_distributed_init_single_host_noop(monkeypatch):
+    agent_mod, calls = _capture_init(monkeypatch, process_count=1)
+    assert agent_mod.maybe_initialize_distributed() is False
+    assert calls == []
+
+
+def test_distributed_init_already_initialized_is_fine(monkeypatch):
+    agent_mod, calls = _capture_init(monkeypatch, process_count=2)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+
+    def boom(**kw):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(agent_mod.jax.distributed, "initialize", boom)
+    assert agent_mod.maybe_initialize_distributed() is True
+
+    def hard(**kw):
+        raise RuntimeError("coordination service unreachable")
+
+    monkeypatch.setattr(agent_mod.jax.distributed, "initialize", hard)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="unreachable"):
+        agent_mod.maybe_initialize_distributed()

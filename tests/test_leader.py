@@ -409,3 +409,38 @@ def test_crashed_leader_fails_over_after_lease_expiry():
     # stop() releases replica-2's own term, so the holder is either the
     # standby (release raced the join) or already cleared.
     assert _lease(cluster)["spec"]["holderIdentity"] in ("replica-2", "")
+
+
+def test_identity_and_timestamp_utils():
+    from k8s_operator_libs_tpu.k8s.leader import (
+        _format_micro,
+        _parse_micro,
+        default_identity,
+    )
+
+    ident = default_identity()
+    assert "_" in ident and len(ident.rsplit("_", 1)[1]) == 8
+    # round trip with microseconds
+    ts = 1_750_000_000.123456
+    assert abs(_parse_micro(_format_micro(ts), 0.0) - ts) < 1e-3
+    # fallbacks: empty, garbage, bad fraction
+    assert _parse_micro("", 7.0) == 7.0
+    assert _parse_micro("not-a-time", 7.0) == 7.0
+    assert _parse_micro("2026-07-30T10:00:00.xyzZ", 7.0) > 0  # frac dropped
+
+
+def test_release_survives_api_errors():
+    """release() is best-effort on the shutdown path: an apiserver error
+    must not raise out of the finally block."""
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    clock = {"t": 0.0}
+    a = _clocked(cluster, "a", clock)
+    assert a.acquire_or_renew()
+
+    def down(*args, **kw):
+        raise OSError("apiserver unreachable")
+
+    cluster.update_custom_object = down
+    a.release()  # must not raise
+    assert not a.is_leader()

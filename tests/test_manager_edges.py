@@ -236,3 +236,33 @@ def test_rollback_eviction_failure_is_best_effort():
     assert vm.wait_idle(15.0)
     # The blocked pod survived (best-effort), nothing raised.
     assert cluster.get_pod(NAMESPACE, "stuck") is not None
+
+
+def test_unblock_loading_single_node_parity():
+    """The per-node unblock (reference safe_driver_load_manager.go:57-71)
+    removes the annotation only when present."""
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+    from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+        NodeUpgradeStateProvider,
+    )
+    from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (
+        SafeDriverLoadManager,
+    )
+
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    provider = NodeUpgradeStateProvider(cluster, keys=keys)
+    mgr = SafeDriverLoadManager(provider, keys=keys)
+    from tests.fixtures import make_node
+
+    waiting = make_node("n0", annotations={keys.safe_load_annotation: "true"})
+    cluster.create_node(waiting)
+    idle = make_node("n1")
+    cluster.create_node(idle)
+    assert mgr.is_waiting_for_safe_driver_load(waiting)
+    mgr.unblock_loading(waiting)
+    assert not cluster.get_node("n0", cached=False).annotations.get(
+        keys.safe_load_annotation
+    )
+    mgr.unblock_loading(idle)  # no-op path: no annotation, no write
