@@ -9,9 +9,13 @@ What runs (the BASELINE north-star scenario, scaled to the harness):
   with apiserver latency + read-cache lag, the same semantics envtest
   gives the reference's tests);
 - the real slice-aware upgrade engine rolling a driver DaemonSet across
-  all four slices atomically under maxParallelUpgrades=1, TWICE: once
-  sequential (validation gate holds the slot) and once with pipelined
-  validation (optimistic uncordon overlaps the next slice's drain);
+  all four slices atomically, THREE times: sequential under
+  maxParallelUpgrades=1 (validation gate holds the slot), pipelined
+  validation (optimistic uncordon overlaps the next slice's drain), and
+  a DCN variant (BASELINE config 5 shape: two 2-slice rings,
+  parallelism 2, dcn_anti_affinity — two slices roll concurrently but
+  never two of one ring, so a DP-pair canary spanning ring-a sees two
+  serialized single-slice windows, not a double outage);
 - the REAL JAX health gate with the production HBM floor (50 % of the
   chip's published spec bandwidth): 16 distinct per-host probe agents
   each run their own battery on the accelerator and publish per-host
@@ -54,6 +58,7 @@ sys.path.insert(0, os.path.join(_ROOT, "tests"))
 
 from k8s_operator_libs_tpu.api import (  # noqa: E402
     DrainSpec,
+    IntOrString,
     SliceHealthGateSpec,
     TPUUpgradePolicySpec,
 )
@@ -101,6 +106,45 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# The tunneled backend can wedge indefinitely inside a single device call
+# (observed: a device_put that never returned after 20+ min while the
+# process stayed alive).  A blocked main thread can't honor any Python
+# timeout, but a daemon timer still fires — so the bench always emits its
+# one JSON line: an honest failure record beats silence at round end.
+BENCH_WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", "1320"))
+
+
+def _start_watchdog(metric: str) -> threading.Timer:
+    def fire() -> None:
+        log(
+            f"WATCHDOG: bench exceeded {BENCH_WATCHDOG_S:.0f}s "
+            "(wedged backend call?); emitting failure record"
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": 0.0,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "details": {
+                        "complete": False,
+                        "watchdog_timeout_s": BENCH_WATCHDOG_S,
+                        "error": "bench wall-clock watchdog fired; a "
+                        "device call most likely wedged (tunnel outage)",
+                    },
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    timer = threading.Timer(BENCH_WATCHDOG_S, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def derive_slice_shape(devices) -> tuple[str, str, int]:
     """(accelerator label, topology, chips_per_host) consistent with the
     real device inventory: HOSTS_PER_SLICE hosts of len(devices) chips.
@@ -121,9 +165,15 @@ def derive_slice_shape(devices) -> tuple[str, str, int]:
 class RollHarness:
     """One fresh cluster + engine + agent fleet for one rolling upgrade."""
 
-    def __init__(self, devices, pipeline: bool) -> None:
+    def __init__(self, devices, pipeline: bool, dcn: bool = False) -> None:
         self.devices = devices
         self.pipeline = pipeline
+        # BASELINE config 5 shape: two 2-slice DCN rings (pools 0+1 =
+        # ring-a, pools 2+3 = ring-b).  Under dcn_anti_affinity the
+        # engine may run two slices concurrently ONLY from different
+        # rings, so a DP workload spanning a ring never loses both of
+        # its slices at once.
+        self.dcn = dcn
         self.cluster = FakeCluster(api_latency_s=0.001, cache_lag_s=0.05)
         self.keys = UpgradeKeys()
         fx = ClusterFixture(self.cluster, self.keys)
@@ -137,6 +187,11 @@ class RollHarness:
                 accelerator=accelerator,
                 topology=topology,
                 chips_per_host=chips_per_host,
+                **(
+                    {"dcn_group": "ring-a" if i < 2 else "ring-b"}
+                    if dcn
+                    else {}
+                ),
             )
             for i in range(N_SLICES)
         ]
@@ -162,12 +217,21 @@ class RollHarness:
         self.mgr.with_validation_enabled(self.prober)
         self.policy = TPUUpgradePolicySpec(
             auto_upgrade=True,
-            max_parallel_upgrades=1,
+            # DCN mode allows 2 slices in flight; anti-affinity is what
+            # keeps them in different rings.  The unavailability budget
+            # must allow it too — the 25% default (= 1 of 4 slices)
+            # would silently serialize the rings and the overlap claim
+            # would be vacuous.
+            max_parallel_upgrades=2 if dcn else 1,
+            # (explicit None would mean UNLIMITED; the non-dcn rolls
+            # keep the 25% default.)
+            **({"max_unavailable": IntOrString("50%")} if dcn else {}),
             drain_spec=DrainSpec(enable=True, timeout_second=30),
             health_gate=SliceHealthGateSpec(
                 enable=True, timeout_second=VALIDATION_TIMEOUT_S
             ),
             pipeline_validation=pipeline,
+            dcn_anti_affinity=True,
         )
 
         # Per-host agent fleet: every host gets its OWN agent and battery
@@ -206,6 +270,9 @@ class RollHarness:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.max_concurrent_unavailable = 0
+        # Per-DCN-ring concurrency high-water mark (dcn mode): the
+        # anti-affinity invariant is that this never exceeds 1.
+        self.max_ring_unavailable = 0
 
     # -- agent fleet --------------------------------------------------------
 
@@ -269,11 +336,16 @@ class RollHarness:
 
     def _sampler_loop(self) -> None:
         while not self._stop.is_set():
-            concurrent = sum(
-                1 for nodes in self.slices if self._slice_unavailable(nodes)
-            )
+            down = [
+                self._slice_unavailable(nodes) for nodes in self.slices
+            ]
+            concurrent = sum(down)
             if concurrent > self.max_concurrent_unavailable:
                 self.max_concurrent_unavailable = concurrent
+            if self.dcn:
+                per_ring = max(sum(down[:2]), sum(down[2:]))
+                if per_ring > self.max_ring_unavailable:
+                    self.max_ring_unavailable = per_ring
             time.sleep(0.02)
 
     # -- attribution check ---------------------------------------------------
@@ -368,6 +440,11 @@ class RollHarness:
             "wall_s": round(wall_s, 2),
             "ticks": ticks,
             "max_concurrent_unavailable": self.max_concurrent_unavailable,
+            **(
+                {"max_ring_unavailable": self.max_ring_unavailable}
+                if self.dcn
+                else {}
+            ),
             "transitions": transitions,
             **(
                 {}
@@ -390,6 +467,10 @@ class RollHarness:
 
 
 def main() -> None:
+    watchdog = _start_watchdog(
+        "jax workload downtime during slice-atomic libtpu "
+        "rolling upgrade (4x4-host pool, real probe gate)"
+    )
     devices = jax.devices()
     log(f"bench devices: {[d.device_kind for d in devices]}")
     accelerator, topology, chips_per_host = derive_slice_shape(devices)
@@ -425,23 +506,30 @@ def main() -> None:
     for _ in range(3):
         canary.run_step()  # compile warmup
 
-    def roll_with_canary(harness: RollHarness) -> tuple[dict, float]:
-        """Run one roll with the canary training on slice 0 throughout.
+    def roll_with_canary(
+        harness: RollHarness, canary_slices: tuple[int, ...] = (0,)
+    ) -> tuple[dict, float]:
+        """Run one roll with the canary training on ``canary_slices``.
 
-        Honest downtime: if pool-0 is still disrupted at measurement end
-        (or the roll died), the OPEN interval since the canary's last
-        completed step counts — a terminally-stalled workload must report
-        ~stall-length downtime, not the tiny gaps it saw while alive."""
+        One slice models a single-slice job; a pair models a DCN DP
+        workload (a step needs BOTH slices of its ring, so disruption of
+        either pauses it).  Honest downtime: if the canary's slices are
+        still disrupted at measurement end (or the roll died), the OPEN
+        interval since the last completed step counts — a terminally-
+        stalled workload must report ~stall-length downtime, not the
+        tiny gaps it saw while alive."""
         canary.reset_timing()
         stop = threading.Event()
 
+        def disrupted() -> bool:
+            return any(harness.slice_disrupted(i) for i in canary_slices)
+
         def canary_loop() -> None:
-            # The canary "runs on" slice 0: while any of its hosts is
-            # cordoned the slice cannot host the collective, so steps
-            # pause — the measured gap is the real interruption a JobSet
-            # would see.
+            # While any host of a canary slice is cordoned that slice
+            # cannot host the collective, so steps pause — the measured
+            # gap is the real interruption a JobSet would see.
             while not stop.is_set():
-                if harness.slice_disrupted(0):
+                if disrupted():
                     time.sleep(0.01)
                     continue
                 canary.run_step()
@@ -461,7 +549,7 @@ def main() -> None:
                 "canary thread did not stop; measurements would be corrupt"
             )
         end = time.monotonic()
-        still_down = harness.slice_disrupted(0)
+        still_down = disrupted()
         downtime = canary.max_gap_seconds(
             until=end if (still_down or not result["complete"]) else None
         )
@@ -471,11 +559,17 @@ def main() -> None:
     # tunneled chip has noisy windows where under-floor readings can
     # outlast the validation timeout, which is environment, not engine.
     # The attempt count is recorded — a retried run is never silent.
-    def run_variant(pipeline: bool, check_attribution: bool):
+    def run_variant(
+        pipeline: bool,
+        check_attribution: bool,
+        dcn: bool = False,
+        canary_slices: tuple[int, ...] = (0,),
+        label: str = "",
+    ):
         nonlocal attribution
         result = downtime = None
         for attempt in range(2):
-            harness = RollHarness(devices, pipeline=pipeline)
+            harness = RollHarness(devices, pipeline=pipeline, dcn=dcn)
             harness.sweep_agents_once()
             if check_attribution and attempt == 0:
                 attribution = harness.attribution_check()
@@ -483,8 +577,11 @@ def main() -> None:
                     f"attribution check: ok={attribution['ok']} "
                     f"({attribution['detail']})"
                 )
-            log(("pipelined" if pipeline else "sequential") + " roll:")
-            result, downtime = roll_with_canary(harness)
+            log(
+                (label or ("pipelined" if pipeline else "sequential"))
+                + " roll:"
+            )
+            result, downtime = roll_with_canary(harness, canary_slices)
             result["attempts"] = attempt + 1
             if result["complete"]:
                 break
@@ -510,6 +607,24 @@ def main() -> None:
     log(
         f"pipelined roll: {pipe_result} canary downtime "
         f"{pipe_downtime_s:.3f}s"
+    )
+
+    # -- roll 3: DCN rings (BASELINE config 5 shape) -------------------------
+    # 2 rings x 2 slices, parallelism 2, dcn_anti_affinity: the engine
+    # may take two slices down concurrently but never two of one ring,
+    # so the DP-pair canary (spanning ring-a) sees two serialized
+    # single-slice windows instead of one catastrophic double outage.
+    dcn_result, dcn_downtime_s = run_variant(
+        pipeline=False,
+        check_attribution=False,
+        dcn=True,
+        canary_slices=(0, 1),
+        label="dcn (2 rings x 2 slices, parallel=2, anti-affinity)",
+    )
+    log(
+        f"dcn roll: {dcn_result} dp-pair canary downtime "
+        f"{dcn_downtime_s:.3f}s (ring high-water "
+        f"{dcn_result.get('max_ring_unavailable')})"
     )
 
     # -- device-sustained canary throughput ----------------------------------
@@ -548,6 +663,19 @@ def main() -> None:
         "canary_steps": steps,
         "canary_perf": perf,
         "canary_device_perf": device_perf,
+        "dcn": {
+            "complete": dcn_result["complete"],
+            "wall_s": dcn_result["wall_s"],
+            "max_concurrent_unavailable": dcn_result[
+                "max_concurrent_unavailable"
+            ],
+            "max_ring_unavailable": dcn_result.get(
+                "max_ring_unavailable", 0
+            ),
+            "anti_affinity_held": dcn_result.get("max_ring_unavailable", 0)
+            <= 1,
+            "dp_pair_downtime_s": round(dcn_downtime_s, 3),
+        },
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -563,6 +691,7 @@ def main() -> None:
         details["probe_failures"] = probe_failures
     if not complete:
         details["final_states"] = seq_result.get("final_states")
+    watchdog.cancel()
     print(
         json.dumps(
             {
