@@ -149,3 +149,43 @@ def test_cover_executable_lines():
         os.path.join(REPO_ROOT, "k8s_operator_libs_tpu", "consts.py")
     )
     assert len(lines) > 5  # real statements found, nested scopes included
+
+
+def test_bench_watchdog_emits_failure_json():
+    """A wedged device call blocks the bench's main thread forever; the
+    daemon watchdog must still deliver the one-JSON-line contract (an
+    honest failure record) and exit."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_WATCHDOG_S="0.2",
+        PYTHONPATH=REPO_ROOT,
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import time, bench\n"
+                "bench._start_watchdog('m')\n"
+                "time.sleep(30)  # stand-in for a wedged device call\n"
+            ),
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO_ROOT,
+        timeout=60,
+    )
+    assert proc.returncode == 3
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "m"
+    assert out["vs_baseline"] == 0.0
+    assert out["details"]["complete"] is False
+    assert "watchdog" in out["details"]["error"]
+    assert "WATCHDOG" in proc.stderr
