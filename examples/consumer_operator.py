@@ -80,14 +80,35 @@ def load_policy() -> TPUUpgradePolicySpec:
 
 
 def run_reconcile_loop(
-    client, interval_s: float = 30.0, max_passes: int | None = None
+    client,
+    interval_s: float = 30.0,
+    max_passes: int | None = None,
+    leader_elect: bool = False,
+    elector=None,
 ) -> None:
     """The consumer-owned loop: snapshot, tick, sleep — identical shape
-    to a controller-runtime Reconcile with a resync period."""
+    to a controller-runtime Reconcile with a resync period.
+
+    ``leader_elect`` shows the HA pattern for a consumer running 2+
+    replicas: only the Lease holder reconciles, everyone else stands by
+    (the same library protocol the bundled controller uses)."""
+    if leader_elect and elector is None:
+        from k8s_operator_libs_tpu.k8s.leader import (
+            LeaderElector,
+            ensure_lease_kind,
+        )
+
+        ensure_lease_kind(client)  # no-op on a real apiserver
+        elector = LeaderElector(
+            client, namespace=NAMESPACE, name=f"{DRIVER_NAME}-operator"
+        )
     mgr = build_manager(client)
     policy = load_policy()
     passes = 0
     while max_passes is None or passes < max_passes:
+        if elector is not None and not elector.acquire_or_renew():
+            time.sleep(min(elector.retry_period_s, interval_s))
+            continue
         state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
         mgr.apply_state(state, policy)
         mgr.wait_for_async_work()
@@ -100,15 +121,26 @@ def run_reconcile_loop(
         passes += 1
         if max_passes is None:
             time.sleep(interval_s)
+    if elector is not None:
+        elector.release()  # clean handover to the standby replica
 
 
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--interval", type=float, default=30.0)
+    parser.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="run 2+ replicas safely: only the Lease holder reconciles",
+    )
     args = parser.parse_args()
     from k8s_operator_libs_tpu.k8s import get_default_client
 
-    run_reconcile_loop(get_default_client(), interval_s=args.interval)
+    run_reconcile_loop(
+        get_default_client(),
+        interval_s=args.interval,
+        leader_elect=args.leader_elect,
+    )
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ from examples.consumer_operator import (
     load_policy,
 )
 from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import ClusterUpgradeStateManager
 from tests.fixtures import ClusterFixture
 
 
@@ -79,3 +80,61 @@ def test_run_reconcile_loop_bounded():
     _fixture(cluster, mgr.keys)
     # Drives a few passes without error on an unconverged cluster.
     run_reconcile_loop(cluster, max_passes=3)
+
+
+def test_run_reconcile_loop_with_leader_election():
+    """The HA consumer pattern: a standby replica's loop makes zero
+    engine passes while another holds the lease; a clean release hands
+    over and the standby completes its passes."""
+    import threading
+    import time as _time
+
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+
+    from examples.consumer_operator import (
+        NAMESPACE as EX_NS,
+        run_reconcile_loop,
+    )
+
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    mgr = build_manager(cluster)
+    _fixture(cluster, mgr.keys)
+    blocker = LeaderElector(
+        cluster, identity="other-replica", namespace=EX_NS,
+        name="mydriver-operator",
+    )
+    assert blocker.acquire_or_renew()
+    standby = LeaderElector(
+        cluster, identity="standby", namespace=EX_NS,
+        name="mydriver-operator", retry_period_s=0.01,
+    )
+    calls = {"n": 0}
+    real_build = ClusterUpgradeStateManager.build_state
+
+    def counting(self, *a, **kw):
+        calls["n"] += 1
+        return real_build(self, *a, **kw)
+
+    ClusterUpgradeStateManager.build_state = counting
+    try:
+        t = threading.Thread(
+            target=run_reconcile_loop,
+            kwargs=dict(
+                client=cluster, interval_s=0.01, max_passes=2,
+                leader_elect=True, elector=standby,
+            ),
+            daemon=True,
+        )
+        t.start()
+        _time.sleep(0.3)  # well inside the blocker's 15 s term
+        assert calls["n"] == 0, "standby reconciled under a live term"
+        blocker.release()  # clean handover
+        t.join(15.0)
+        assert not t.is_alive(), "standby never took over after release"
+        assert calls["n"] == 2
+    finally:
+        ClusterUpgradeStateManager.build_state = real_build
