@@ -19,8 +19,35 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import random
 import string
+import subprocess
+import sys
 
 import pytest
+
+# None = not probed yet; True/False = session verdict.
+_BACKEND_OK = None
+
+
+def _backend_available(timeout_s: float = 90.0) -> bool:
+    """Probe jax backend init in a SUBPROCESS with a timeout.
+
+    When the environment registers a remote accelerator plugin (axon
+    tunnel), ANY device call — including jax.devices('cpu') — initializes
+    it, and during a relay outage that init wedges for ~45 min.  Probing
+    in-process would hang the whole suite at its first device test; a
+    killed subprocess instead turns the outage into visible skips."""
+    global _BACKEND_OK
+    if _BACKEND_OK is None:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices('cpu')"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            _BACKEND_OK = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            _BACKEND_OK = False
+    return _BACKEND_OK
 
 
 @pytest.fixture
@@ -37,6 +64,11 @@ def cpu_devices():
     When a TPU plugin is registered in the environment it stays the
     *default* backend regardless of JAX_PLATFORMS, so every JAX test
     requests the CPU backend explicitly and passes devices through."""
+    if not _backend_available():
+        pytest.skip(
+            "jax backend init unavailable (accelerator relay outage); "
+            "device-tier tests skipped"
+        )
     import jax
 
     return jax.devices("cpu")
