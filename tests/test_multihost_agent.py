@@ -77,7 +77,7 @@ def _group(nodes, topology: str) -> UpgradeGroup:
     )
 
 
-def test_two_process_agents_publish_slice_wide_reports():
+def test_two_process_agents_publish_slice_wide_reports(cpu_devices):
     store = FakeCluster()
     fx = ClusterFixture(store, KEYS)
     nodes = [
