@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -67,6 +68,7 @@ from k8s_operator_libs_tpu.health import (  # noqa: E402
     run_host_probe,
 )
 from k8s_operator_libs_tpu.health.agent import HealthAgent  # noqa: E402
+from k8s_operator_libs_tpu.hostenv import sanitized_cpu_env  # noqa: E402
 from k8s_operator_libs_tpu.hw import chip_spec  # noqa: E402
 from k8s_operator_libs_tpu.k8s import FakeCluster, NotFoundError  # noqa: E402
 from k8s_operator_libs_tpu.upgrade import (  # noqa: E402
@@ -112,6 +114,69 @@ def log(msg: str) -> None:
 # timeout, but a daemon timer still fires — so the bench always emits its
 # one JSON line: an honest failure record beats silence at round end.
 BENCH_WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", "1320"))
+
+# Backend pre-flight: a relay outage makes backend init HANG (not raise),
+# so probing must happen in a killable subprocess BEFORE this process
+# touches jax.devices().  One retry bridges a tunnel blip; a persistent
+# outage falls back to a sanitized cpu backend so the round still lands a
+# completed, honestly-labeled artifact (the engine, gate, and downtime
+# machinery are backend-agnostic; only the probe TFLOPS/GB/s figures need
+# the real chip).
+PREFLIGHT_TIMEOUT_S = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT_S", "90"))
+PREFLIGHT_RETRY_WAIT_S = float(
+    os.environ.get("BENCH_PREFLIGHT_RETRY_WAIT_S", "30")
+)
+
+
+def _fallback_env(remaining_budget_s: float) -> dict:
+    """Environment for the cpu-fallback re-exec: the shared sanitized-cpu
+    environment plus bench-specific knobs — cheap probe floors and the
+    watchdog budget that is left."""
+    env = sanitized_cpu_env()
+    env["BENCH_FORCED_CPU"] = "1"
+    # CPU probes measure dispatch-dominated ops; the production 50 ms
+    # differential floor would escalate every sustained window.
+    env["K8S_TPU_PROBE_MIN_TIME_S"] = "0.01"
+    env["BENCH_WATCHDOG_S"] = f"{max(remaining_budget_s, 300.0):.0f}"
+    return env
+
+
+def _ensure_live_backend() -> None:
+    """Pre-flight the configured backend in a killed subprocess; re-exec
+    this bench on a sanitized cpu backend if it is unreachable."""
+    if os.environ.get("BENCH_FORCED_CPU") == "1":
+        return
+    t0 = time.monotonic()
+    for attempt in (1, 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=PREFLIGHT_TIMEOUT_S,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                log(
+                    f"backend pre-flight ok "
+                    f"({time.monotonic() - t0:.1f}s)"
+                )
+                return
+            err = proc.stderr.decode(errors="replace")[-300:]
+        except subprocess.TimeoutExpired:
+            err = f"backend init hung {PREFLIGHT_TIMEOUT_S:.0f}s (outage)"
+        log(f"backend pre-flight {attempt}/2 failed: {err}")
+        if attempt == 1:
+            time.sleep(PREFLIGHT_RETRY_WAIT_S)
+    remaining = BENCH_WATCHDOG_S - (time.monotonic() - t0)
+    log(
+        "backend unreachable after retry; re-exec on sanitized cpu "
+        f"backend ({remaining:.0f}s budget left) — details.backend will "
+        "say so honestly"
+    )
+    os.execve(
+        sys.executable,
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        _fallback_env(remaining),
+    )
 
 
 def _start_watchdog(metric: str) -> threading.Timer:
@@ -165,9 +230,16 @@ def derive_slice_shape(devices) -> tuple[str, str, int]:
 class RollHarness:
     """One fresh cluster + engine + agent fleet for one rolling upgrade."""
 
-    def __init__(self, devices, pipeline: bool, dcn: bool = False) -> None:
+    def __init__(
+        self, devices, pipeline: bool, dcn: bool = False,
+        small_battery: bool = False,
+    ) -> None:
         self.devices = devices
         self.pipeline = pipeline
+        # cpu-fallback mode: dispatch-dominated backend, so the agent
+        # batteries shrink to stay honest about wall-clock without
+        # changing any gate semantics.
+        self.small_battery = small_battery
         # BASELINE config 5 shape: two 2-slice DCN rings (pools 0+1 =
         # ring-a, pools 2+3 = ring-b).  Under dcn_anti_affinity the
         # engine may run two slices concurrently ONLY from different
@@ -246,6 +318,10 @@ class RollHarness:
         for si, nodes in enumerate(self.slices):
             for n in nodes:
                 big = si == 0
+                if small_battery:
+                    matmul_n, hbm_mib = (128 if big else 64), 16
+                else:
+                    matmul_n, hbm_mib = (1024 if big else 256), 1024
                 self.agents.append(
                     HealthAgent(
                         self.cluster,
@@ -253,8 +329,8 @@ class RollHarness:
                         self.keys,
                         driver_revision="v2",
                         devices=devices,
-                        matmul_n=1024 if big else 256,
-                        hbm_mib=1024,
+                        matmul_n=matmul_n,
+                        hbm_mib=hbm_mib,
                         allreduce_elems=(1 << 16) if big else (1 << 12),
                         # Bounded sustained windows: these agents share the
                         # ONE bench chip with the canary, and an escalating
@@ -471,6 +547,8 @@ def main() -> None:
         "jax workload downtime during slice-atomic libtpu "
         "rolling upgrade (4x4-host pool, real probe gate)"
     )
+    _ensure_live_backend()
+    cpu_fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
     devices = jax.devices()
     log(f"bench devices: {[d.device_kind for d in devices]}")
     accelerator, topology, chips_per_host = derive_slice_shape(devices)
@@ -480,11 +558,27 @@ def main() -> None:
     )
 
     # -- production-size probe battery (spec-comparable TFLOPS / GB/s) ------
+    # cpu fallback keeps the battery structurally identical but small —
+    # the numbers are labeled by details.backend either way.
+    battery_kw = (
+        {"matmul_n": 256, "hbm_mib": 32} if cpu_fallback else {}
+    )
+
+    def run_battery() -> list:
+        # defaults: n=4096, 1 GiB stream.  A transient tunnel error
+        # RAISES (a wedge is the watchdog's job); one retry bridges it.
+        try:
+            return run_host_probe(devices, **battery_kw)
+        except Exception as exc:  # noqa: BLE001 — deliberate blip retry
+            log(f"probe battery raised ({exc!r}); retrying once in 20s")
+            time.sleep(20.0)
+            return run_host_probe(devices, **battery_kw)
+
     t_probe = time.monotonic()
-    warm = run_host_probe(devices)  # defaults: n=4096, 1 GiB stream
+    warm = run_battery()
     probe_warm_s = time.monotonic() - t_probe
     t_probe = time.monotonic()
-    hot = run_host_probe(devices)
+    hot = run_battery()
     probe_hot_s = time.monotonic() - t_probe
     probe_metrics = {c.name: c.metrics for c in hot if c.metrics}
     probe_failures = {c.name: c.detail for c in warm + hot if not c.ok}
@@ -498,10 +592,18 @@ def main() -> None:
     # still resolving sub-second interruptions: the per-step host round
     # trip over the tunnel bounds wall MFU, so bigger matmuls per trip
     # raise utilisation without coarsening the downtime clock past ~0.3 s.
-    canary_cfg = CanaryConfig(
-        vocab=1024, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
-        seq_len=512, batch=32,
-    )
+    # The cpu fallback keeps the same architecture at toy size so steps
+    # still resolve sub-second gaps on a dispatch-bound backend.
+    if cpu_fallback:
+        canary_cfg = CanaryConfig(
+            vocab=256, d_model=128, n_heads=4, n_layers=2, d_ff=512,
+            seq_len=64, batch=8,
+        )
+    else:
+        canary_cfg = CanaryConfig(
+            vocab=1024, d_model=1024, n_heads=16, n_layers=8, d_ff=4096,
+            seq_len=512, batch=32,
+        )
     canary = CanaryRunner(canary_cfg)
     for _ in range(3):
         canary.run_step()  # compile warmup
@@ -569,7 +671,10 @@ def main() -> None:
         nonlocal attribution
         result = downtime = None
         for attempt in range(2):
-            harness = RollHarness(devices, pipeline=pipeline, dcn=dcn)
+            harness = RollHarness(
+                devices, pipeline=pipeline, dcn=dcn,
+                small_battery=cpu_fallback,
+            )
             harness.sweep_agents_once()
             if check_attribution and attempt == 0:
                 attribution = harness.attribution_check()
@@ -682,6 +787,16 @@ def main() -> None:
         "probe_metrics": probe_metrics,
         "device": devices[0].device_kind,
         "n_devices": len(devices),
+        # Honest backend attribution: "default" means the real chip;
+        # "cpu-fallback" means the accelerator relay was unreachable at
+        # bench time and the roll ran on the sanitized cpu backend (the
+        # engine/gate/downtime machinery is backend-agnostic; only the
+        # probe TFLOPS/GB/s lose spec-comparability).
+        "backend": (
+            "cpu-fallback (accelerator relay unreachable at pre-flight)"
+            if cpu_fallback
+            else "default"
+        ),
         "downtime_budget_s": DOWNTIME_BUDGET_S,
         "validation_timeout_s": VALIDATION_TIMEOUT_S,
     }

@@ -1,0 +1,124 @@
+"""Host-environment sanitization for outage-proof backend selection.
+
+This rig (and GKE nodes mid-libtpu-upgrade generally) can have a
+registered accelerator plugin whose backend init HANGS rather than
+raising — observed with the remote-relay plugin during the 2026-07-30
+outage: any device call, including ``jax.devices("cpu")`` under
+``JAX_PLATFORMS=cpu``, wedged every process that had the plugin
+registered.  Anything that must keep working through such an outage
+(the test suite, ``__graft_entry__.dryrun_multichip``, ``bench.py``'s
+cpu fallback) runs its device work in an environment with the plugin
+unloadable.  The knowledge of HOW to build that environment lives here,
+once — three hand-rolled copies drifted in round 4's first draft.
+
+Two halves:
+
+- :func:`sanitized_cpu_env` — env dict for a CHILD process: plugin site
+  dir stripped from PYTHONPATH, its sitecustomize gate var dropped, cpu
+  platform pinned, optional virtual-device count.
+- :func:`pin_current_process_to_cpu` — best-effort in-process version
+  for an interpreter whose sitecustomize already registered the plugin
+  at startup (registration precedes any conftest/module code, so env
+  mutation alone is too late): deregister the factory and re-pin the
+  already-captured jax config.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Names whose presence marks the remote-accelerator plugin: the PYTHONPATH
+# site-dir basename substring, and the sitecustomize env var that gates
+# its registration.
+PLUGIN_PATH_MARKER = "axon"
+PLUGIN_GATE_ENV_VAR = "PALLAS_AXON_POOL_IPS"
+PLUGIN_BACKEND_NAME = "axon"
+
+
+def _is_plugin_path(entry: str) -> bool:
+    return PLUGIN_PATH_MARKER in os.path.basename(
+        os.path.normpath(entry or "")
+    )
+
+
+def sanitized_cpu_env(
+    base_env: Optional[dict] = None,
+    *,
+    host_device_count: Optional[int] = None,
+    prepend_pythonpath: Optional[str] = None,
+) -> dict:
+    """A copy of ``base_env`` (default ``os.environ``) in which a child
+    interpreter cannot load the remote-accelerator plugin and resolves
+    the cpu platform.
+
+    ``host_device_count``: set ``--xla_force_host_platform_device_count``
+    (replacing any existing value) for an n-device virtual mesh.
+    ``prepend_pythonpath``: path the child needs importable (e.g. the
+    repo root for ``import __graft_entry__``)."""
+    env = dict(os.environ if base_env is None else base_env)
+    env.pop(PLUGIN_GATE_ENV_VAR, None)
+    entries = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not _is_plugin_path(p)
+    ]
+    if prepend_pythonpath:
+        entries.insert(0, prepend_pythonpath)
+    if entries:
+        env["PYTHONPATH"] = os.pathsep.join(entries)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if host_device_count is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={host_device_count}"
+        )
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def pin_current_process_to_cpu(
+    default_host_device_count: Optional[int] = None,
+) -> bool:
+    """Best-effort: make THIS interpreter's jax resolve the cpu backend
+    even though the plugin was registered at startup.
+
+    Returns True when the deregistration hack matched jax internals
+    (callers keep a subprocess-probe guard for the day it doesn't).
+    Also sanitizes ``os.environ`` so child processes inherit a safe
+    environment.  Call before the first device call.
+
+    ``default_host_device_count``: ensure a virtual-device count is set
+    WITHOUT replacing one already present (an operator running with a
+    custom count keeps it)."""
+    if default_host_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count"
+                f"={default_host_device_count}"
+            ).strip()
+    clean = sanitized_cpu_env(dict(os.environ))
+    # Only adopt the sanitization keys; leave everything else untouched.
+    for key in ("PYTHONPATH", "XLA_FLAGS"):
+        if key in clean:
+            os.environ[key] = clean[key]
+        else:
+            os.environ.pop(key, None)
+    os.environ.pop(PLUGIN_GATE_ENV_VAR, None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop(PLUGIN_BACKEND_NAME, None)
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:  # noqa: BLE001 — internals moved; caller's guard
+        return False

@@ -1,26 +1,51 @@
-"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax backend init.
 
 Multi-chip TPU hardware is not available in CI; sharding/collective tests
 run on a virtual 8-device CPU backend (the TPU code paths are identical
-under jit — only the XLA target differs)."""
+under jit — only the XLA target differs).
+
+Outage sanitization: this rig reaches its one real TPU through a remote
+PJRT plugin whose sitecustomize registers it in EVERY interpreter at
+startup (before pytest imports this conftest).  During a relay outage the
+plugin's backend init HANGS forever — it does not raise — and it runs on
+the FIRST device call even for ``jax.devices("cpu")`` under
+``JAX_PLATFORMS=cpu``, so a single device-touching test would wedge the
+whole suite (observed: 413-test run frozen at test 9 for 7+ min).  Tests
+are CPU-tier by design; ``bench.py`` is the only consumer of the real
+chip.  So, before any backend init:
+
+1. deregister the plugin's backend factory from this interpreter,
+2. pin the already-imported jax config to the cpu platform,
+3. sanitize ``os.environ`` so child processes (multihost gloo workers,
+   probe subprocesses) neither re-register the plugin nor inherit a
+   non-cpu platform.
+
+The subprocess probe in ``_backend_available`` stays as a second line of
+defense: if the deregistration hack ever stops matching jax internals,
+device-tier tests skip visibly instead of hanging.
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # CPU-tier probes measure dispatch-dominated µs ops; the production
 # 50 ms differential floor would escalate every sustained probe to its
 # iteration cap and slow the suite ~10x for no accuracy the tests need.
 os.environ.setdefault("K8S_TPU_PROBE_MIN_TIME_S", "0.01")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+# Sanitize this interpreter (plugin registered at startup via
+# sitecustomize — env mutation alone is too late) AND os.environ for
+# every child (subprocess probes, 2-process jax.distributed workers),
+# with the 8-device virtual mesh unless the environment already set one.
+from k8s_operator_libs_tpu.hostenv import (  # noqa: E402
+    pin_current_process_to_cpu,
+)
+
+pin_current_process_to_cpu(default_host_device_count=8)
 
 import random
 import string
 import subprocess
-import sys
 
 import pytest
 
@@ -31,11 +56,11 @@ _BACKEND_OK = None
 def _backend_available(timeout_s: float = 90.0) -> bool:
     """Probe jax backend init in a SUBPROCESS with a timeout.
 
-    When the environment registers a remote accelerator plugin (axon
-    tunnel), ANY device call — including jax.devices('cpu') — initializes
-    it, and during a relay outage that init wedges for ~45 min.  Probing
-    in-process would hang the whole suite at its first device test; a
-    killed subprocess instead turns the outage into visible skips."""
+    With the sanitized environment above this passes even during a relay
+    outage (the cpu backend needs no tunnel).  It exists for the day the
+    deregistration above stops matching jax internals: probing in-process
+    would hang the whole suite at its first device test; a killed
+    subprocess instead turns the failure into visible skips."""
     global _BACKEND_OK
     if _BACKEND_OK is None:
         try:
@@ -61,9 +86,9 @@ def rand_suffix():
 def cpu_devices():
     """The 8 virtual CPU devices JAX tests run on.
 
-    When a TPU plugin is registered in the environment it stays the
-    *default* backend regardless of JAX_PLATFORMS, so every JAX test
-    requests the CPU backend explicitly and passes devices through."""
+    Every JAX test requests the CPU backend explicitly and passes devices
+    through, so a test never depends on what the environment's *default*
+    backend happens to be."""
     if not _backend_available():
         pytest.skip(
             "jax backend init unavailable (accelerator relay outage); "

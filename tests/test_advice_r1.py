@@ -207,11 +207,12 @@ def test_agent_reports_zero_devices_when_backend_broken(monkeypatch):
     assert "device enumeration failed" in raw
 
 
-def test_agent_healthy_report_carries_device_count():
+def test_agent_healthy_report_carries_device_count(cpu_devices):
     cluster = FakeCluster()
     ClusterFixture(cluster, KEYS).node("host-0")
     agent = HealthAgent(
-        cluster, "host-0", KEYS, matmul_n=64, hbm_mib=1, allreduce_elems=64
+        cluster, "host-0", KEYS, devices=cpu_devices[:1],
+        matmul_n=64, hbm_mib=1, allreduce_elems=64
     )
     report = agent.probe_once()
     assert report.visible_devices >= 1

@@ -48,7 +48,7 @@ def test_sharded_matches_single_device(cpu_devices):
         assert l_sh == pytest.approx(l_si, rel=2e-2)
 
 
-def test_gap_measurement():
+def test_gap_measurement(cpu_devices):
     runner = CanaryRunner(TINY)
     runner.run_step()
     runner.run_step()

