@@ -110,6 +110,12 @@ def run_reconcile_loop(
             time.sleep(min(elector.retry_period_s, interval_s))
             continue
         state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        # Re-check right before the mutating phase: a build_state that
+        # outlives the 10 s renew deadline must not cordon/drain
+        # concurrently with a successor that already took over (the
+        # controller's ``_still_leading`` guard, in example form).
+        if elector is not None and not elector.acquire_or_renew():
+            continue
         mgr.apply_state(state, policy)
         mgr.wait_for_async_work()
         print(
@@ -120,9 +126,29 @@ def run_reconcile_loop(
         )
         passes += 1
         if max_passes is None:
-            time.sleep(interval_s)
+            renewing_sleep(elector, interval_s)
     if elector is not None:
         elector.release()  # clean handover to the standby replica
+
+
+def renewing_sleep(elector, seconds: float) -> None:
+    """Sleep in retry-period chunks, renewing the Lease between chunks.
+
+    A plain ``time.sleep(interval_s)`` would forfeit the lease every
+    pass (interval 30 s > the 15 s default term) and ping-pong
+    leadership with the standby; this mirrors the bundled controller's
+    ``_wait``."""
+    deadline = time.monotonic() + seconds
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        chunk = remaining
+        if elector is not None:
+            chunk = min(chunk, elector.retry_period_s)
+        time.sleep(chunk)
+        if elector is not None:
+            elector.acquire_or_renew()
 
 
 def main() -> None:

@@ -32,6 +32,8 @@ def gather(
     keys: Optional[UpgradeKeys] = None,
     policy_ref: Optional[tuple[str, str]] = None,
     max_events: int = 10,
+    lease_name: str = "tpu-upgrade-controller",
+    lease_namespace: Optional[str] = None,
 ) -> dict:
     """Collect the status snapshot as a JSON-shaped dict (no writes)."""
     keys = keys or UpgradeKeys()
@@ -127,8 +129,9 @@ def gather(
         )
 
         lease = client.get_custom_object(
-            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, namespace,
-            "tpu-upgrade-controller",
+            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL,
+            lease_namespace or namespace,
+            lease_name,
         )
         spec = lease.get("spec") or {}
         out["leader"] = {
@@ -217,6 +220,14 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--selector", default="app=libtpu-driver")
     parser.add_argument("--driver-name", default="libtpu")
     parser.add_argument("--policy-cr", default="", metavar="NAMESPACE/NAME")
+    # Same flags (and defaults) as the controller, so a deployment that
+    # customizes its election Lease still gets a leader section here.
+    parser.add_argument("--lease-name", default="tpu-upgrade-controller")
+    parser.add_argument(
+        "--lease-namespace",
+        default="",
+        help="defaults to --namespace (the controller's behavior)",
+    )
     parser.add_argument("--json", action="store_true", dest="as_json")
     args = parser.parse_args(argv)
     from k8s_operator_libs_tpu.controller import _parse_labels
@@ -234,6 +245,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         _parse_labels(args.selector),
         keys=UpgradeKeys(driver_name=args.driver_name),
         policy_ref=policy_ref,
+        lease_name=args.lease_name,
+        lease_namespace=args.lease_namespace or None,
     )
     print(_json.dumps(status, indent=2) if args.as_json else render(status))
 

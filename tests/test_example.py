@@ -4,6 +4,8 @@ the loop, SURVEY §1)."""
 
 from __future__ import annotations
 
+import threading
+
 import pytest
 
 from examples.consumer_operator import (
@@ -138,3 +140,57 @@ def test_run_reconcile_loop_with_leader_election():
         assert calls["n"] == 2
     finally:
         ClusterUpgradeStateManager.build_state = real_build
+
+
+def test_ha_example_keeps_lease_across_long_sleeps():
+    """The inter-pass sleep must renew the Lease in retry-period chunks;
+    a plain sleep longer than the term would forfeit leadership every
+    pass and let the standby reconcile concurrently (advisor r3)."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+
+    from examples.consumer_operator import (
+        NAMESPACE as EX_NS,
+        renewing_sleep,
+    )
+
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    # 0.8 s term with 0.1 s renewal chunks: 8 missed renewals before a
+    # steal is possible, keeping the zero-steal assertion robust to
+    # loaded-CI scheduler stalls.
+    leader = LeaderElector(
+        cluster, identity="leader", namespace=EX_NS,
+        name="mydriver-operator", lease_duration_s=0.8,
+        renew_deadline_s=0.4, retry_period_s=0.1,
+    )
+    rival = LeaderElector(
+        cluster, identity="rival", namespace=EX_NS,
+        name="mydriver-operator", lease_duration_s=0.8,
+        renew_deadline_s=0.4, retry_period_s=0.1,
+    )
+    assert leader.acquire_or_renew()
+    stop = threading.Event()
+    stolen = []
+
+    def contend():
+        while not stop.is_set():
+            if rival.acquire_or_renew():
+                stolen.append(_time.monotonic())
+            _time.sleep(0.02)
+
+    t = threading.Thread(target=contend, daemon=True)
+    t.start()
+    try:
+        # Sleep 2+ lease terms: the chunked renewal must hold the term
+        # open against an actively-contending rival the whole time.
+        renewing_sleep(leader, 2.0)
+        assert leader.acquire_or_renew(), "leader lost its lease mid-sleep"
+        assert not stolen, "rival acquired during the renewing sleep"
+    finally:
+        stop.set()
+        t.join(2.0)

@@ -373,6 +373,51 @@ def test_standby_watch_pump_holds_no_streams():
     assert not t.is_alive()
 
 
+def test_deposed_leader_drops_watch_streams_promptly():
+    """A replica that LED and then lost the lease must close its watch
+    streams within a bounded interval — heartbeat-driven gate checks,
+    not only on real events (advisor r3: the never-led standby test did
+    not cover this path)."""
+    from k8s_operator_libs_tpu.k8s.leader import _format_micro
+
+    cluster = FakeCluster()
+    ensure_lease_kind(cluster)
+    c = _ha_controller(cluster, "replica-1")
+    c.config.watch = True
+    t = threading.Thread(target=c.run_forever, daemon=True)
+    t.start()
+    try:
+        # Wins the (uncontested) election and starts streaming.
+        deadline = time.monotonic() + 5.0
+        while not cluster._watchers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert cluster._watchers, "leader pump never opened streams"
+        # Usurper takeover: overwrite the Lease with a foreign holder and
+        # a fresh term (apiserver-side view of a replaced leader).
+        lease = cluster.get_custom_object(
+            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, NS,
+            c.config.lease_name,
+        )
+        lease["spec"]["holderIdentity"] = "usurper"
+        lease["spec"]["renewTime"] = _format_micro(time.time())
+        lease["spec"]["leaseDurationSeconds"] = 3600
+        cluster.update_custom_object(
+            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, NS, lease
+        )
+        # The deposed replica must observe the loss and drop its streams
+        # on a quiet cluster (no events flowing) within a few heartbeats.
+        deadline = time.monotonic() + 5.0
+        while cluster._watchers and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not cluster._watchers, (
+            "deposed leader still holds watch streams"
+        )
+    finally:
+        c.stop()
+        t.join(5.0)
+    assert not t.is_alive()
+
+
 def test_crashed_leader_fails_over_after_lease_expiry():
     """A leader that dies WITHOUT releasing (kill -9) is replaced once
     its term lapses — no manual intervention."""

@@ -196,6 +196,39 @@ def test_status_shows_election_leader():
     assert "(none — between terms)" in render(status)
 
 
+def test_status_follows_custom_lease_name_and_namespace():
+    """A controller run with --lease-name/--lease-namespace must still
+    get a leader section here — the status CLI plumbs the same flags
+    (advisor r3: the hardcoded name silently showed no leader)."""
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+
+    cluster, keys = _mid_roll_cluster()
+    ensure_lease_kind(cluster)
+    elector = LeaderElector(
+        cluster,
+        identity="replica-9",
+        namespace="infra-system",
+        name="custom-election",
+    )
+    assert elector.acquire_or_renew()
+    # Default lease coordinates: no leader section (lease is elsewhere).
+    status = gather(cluster, NAMESPACE, DRIVER_LABELS, keys=keys)
+    assert "leader" not in status
+    # The controller's coordinates: leader surfaces.
+    status = gather(
+        cluster,
+        NAMESPACE,
+        DRIVER_LABELS,
+        keys=keys,
+        lease_name="custom-election",
+        lease_namespace="infra-system",
+    )
+    assert status["leader"]["holder"] == "replica-9"
+
+
 def test_status_cli_main_end_to_end(monkeypatch, capsys):
     """python -m k8s_operator_libs_tpu.status --json against a stubbed
     default client: the operator entry point, not just gather()."""
