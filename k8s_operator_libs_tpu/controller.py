@@ -42,6 +42,7 @@ from k8s_operator_libs_tpu.driver.daemonset import (
     DriverSetReconciler,
 )
 from k8s_operator_libs_tpu.health import NodeReportProber
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.metrics import (
     MetricsRegistry,
     MetricsServer,
@@ -112,7 +113,7 @@ class ControllerConfig:
 class UpgradeController:
     """Owns one driver's upgrade lifecycle end to end."""
 
-    def __init__(self, client, config: ControllerConfig) -> None:
+    def __init__(self, client: KubeClient, config: ControllerConfig) -> None:
         self.client = client
         self.config = config
         self.keys = UpgradeKeys(driver_name=config.driver_name)
@@ -568,26 +569,75 @@ class UpgradeController:
         """Background thread: any watch event sets the wake flag; the
         stream is re-established on errors (apiserver restarts).
 
+        Informer reconnect semantics (the client-go list-then-watch
+        loop): each connect first takes a BASELINE — the cluster
+        resourceVersion from a cheap one-item list — and watches from
+        it; every event raises its own KIND's floor, and a reconnect
+        resumes from the MINIMUM floor across kinds.  The per-kind
+        minimum matters: on the wire tier each kind is an independent
+        stream feeding one queue, so the highest rv seen globally may be
+        ahead of an event still buffered in a slower stream — resuming
+        from the max would skip it permanently, while resuming from the
+        min replays at worst a few already-seen events (wakes are
+        idempotent).  A 410 Gone (resume point compacted away) drops the
+        baseline and forces an immediate wake — the pass it triggers
+        re-snapshots the world, which is this controller's re-list.
+
         Under leader election the pump holds streams only while this
         replica leads (controller-runtime starts informers after winning
         the election): a standby discards every event anyway, and on a
         large pool the Pod watch is a heavy stream the apiserver should
         not carry twice."""
+        from k8s_operator_libs_tpu.k8s.client import ExpiredError
+
+        resume_rv: Optional[int] = None
+        floors: dict[str, int] = {}
         while not self._stop:
             gate = self._pump_gate
             if gate is not None and not gate.is_set():
                 gate.wait(0.5)
                 continue
+            kinds = self._watch_kinds()
             try:
-                for ev in self.client.watch_events(self._watch_kinds()):
+                if resume_rv is None:
+                    # Baseline: the cluster RV "now" (shared across
+                    # kinds — one etcd-style sequence), so the watch
+                    # below misses nothing after this instant.
+                    resume_rv = int(
+                        self.client.list_page("Node", limit=1)[
+                            "resourceVersion"
+                        ]
+                    )
+                floors = {
+                    (k.split("/")[-1] if "/" in k else k): resume_rv
+                    for k in kinds
+                }
+                for ev in self.client.watch_events(
+                    kinds, since_rv=resume_rv
+                ):
                     if self._stop:
                         return
                     if gate is not None and not gate.is_set():
-                        break  # lost leadership: drop the streams
+                        # Lost leadership: drop the streams; keep the
+                        # floors so regaining replays the standby gap.
+                        resume_rv = min(floors.values())
+                        break
                     if ev is not None:
+                        if ev.rv and ev.kind in floors:
+                            floors[ev.kind] = max(floors[ev.kind], ev.rv)
                         wake.set()
+            except ExpiredError as e:
+                logger.warning(
+                    "watch resume point expired (%s); re-listing via an "
+                    "immediate reconcile pass",
+                    e,
+                )
+                resume_rv = None
+                wake.set()
             except Exception as e:  # noqa: BLE001 — reconnect, don't die
                 logger.warning("watch stream broke (%s); reconnecting", e)
+                if floors:
+                    resume_rv = min(floors.values())
                 time.sleep(1.0)
 
     def run_forever(self) -> None:

@@ -28,12 +28,14 @@ from k8s_operator_libs_tpu.k8s.objects import (  # noqa: F401
     PodPhase,
 )
 from k8s_operator_libs_tpu.k8s.client import (  # noqa: F401
+    ExpiredError,
     FakeCluster,
     InvalidError,
     NotFoundError,
     WatchEvent,
 )
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper, DrainError  # noqa: F401
+from k8s_operator_libs_tpu.k8s.interface import KubeClient  # noqa: F401
 from k8s_operator_libs_tpu.k8s.rest import (  # noqa: F401
     KubeConfig,
     RestClient,

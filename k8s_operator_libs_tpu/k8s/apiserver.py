@@ -33,6 +33,7 @@ from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import (
     ConflictError,
     EvictionBlockedError,
+    ExpiredError,
     FakeCluster,
     InvalidError,
     NotFoundError,
@@ -232,6 +233,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, _status_body(404, "NotFound", str(e)))
         except ConflictError as e:
             self._send(409, _status_body(409, "AlreadyExists", str(e)))
+        except ExpiredError as e:
+            # 410 Gone, reason Expired: a stale watch resourceVersion or
+            # list continue token (post-compaction semantics).  Clients
+            # re-list and resume.
+            self._send(410, _status_body(410, "Expired", str(e)))
         except InvalidError as e:
             self._send(
                 422,
@@ -267,6 +273,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str, parts: list[str], query: dict) -> None:
         label_selector = query.get("labelSelector", "")
         watching = query.get("watch") == "true"
+        # watch?resourceVersion=N — resume point: retained history after
+        # N replays first, or 410 when compacted away.  Absent = live
+        # only.  (Divergence from k8s's legacy special-casing of "0" as
+        # "any available point": here 0 is a genuine resume point, so the
+        # wire tier and FakeCluster behave identically.)
+        raw_rv = query.get("resourceVersion", "")
+        self._since_rv = int(raw_rv) if raw_rv else None
         # /api/v1/nodes[/{name}]
         if parts[:2] == ["api", "v1"] and len(parts) >= 3 and parts[2] == "nodes":
             if len(parts) == 3:
@@ -275,16 +288,9 @@ class _Handler(BaseHTTPRequestHandler):
                         ["Node"], node_to_json, label_selector=label_selector
                     )
                 if method == "GET":
-                    items = self.store.list_nodes(
-                        label_selector=label_selector
-                    )
-                    return self._send(
-                        200,
-                        {
-                            "apiVersion": "v1",
-                            "kind": "NodeList",
-                            "items": [node_to_json(n) for n in items],
-                        },
+                    return self._paged_list(
+                        "Node", "NodeList", "v1", node_to_json, "",
+                        label_selector, query,
                     )
                 return self._method_not_allowed(method, parts)
             name = parts[3]
@@ -448,10 +454,15 @@ class _Handler(BaseHTTPRequestHandler):
         goes away, in the real apiserver's envelope shape
         ``{"type": ..., "object": {...}}`` (the object carries its own
         kind), scoped by the request's namespace/labelSelector.  Blank
-        lines are heartbeats (clients skip them); there is no replay of
-        pre-subscription events — clients pair watches with periodic
-        resync, like controller-runtime informers."""
-        sub = self.store.watch(kinds)
+        lines are heartbeats (clients skip them).
+
+        ``?resourceVersion=N`` (parsed in _dispatch) resumes from N:
+        retained events after it replay first; a compacted-away N raises
+        ExpiredError BEFORE headers are sent, so the client sees a plain
+        410 Status and re-lists — the informer reconnect contract.
+        Without a resume point there is no replay — clients pair watches
+        with periodic resync, like controller-runtime informers."""
+        sub = self.store.watch(kinds, since_rv=self._since_rv)
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -580,12 +591,54 @@ class _Handler(BaseHTTPRequestHandler):
             node = self.store.get_node(name, cached=False)
         self._send(200, node_to_json(node))
 
+    def _paged_list(
+        self,
+        kind: str,
+        list_kind: str,
+        api_version: str,
+        to_json,
+        namespace: str,
+        label_selector: str,
+        query: dict,
+    ) -> None:
+        """Chunked list (client-go pagination): ``?limit=N`` returns up
+        to N items plus ``metadata.continue``; passing the token back
+        serves the next chunk; an expired token 410s (handled in _route).
+        The list envelope always carries ``metadata.resourceVersion`` —
+        the watch resume point that bridges list → watch."""
+        page = self.store.list_page(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            limit=(int(query["limit"]) if query.get("limit") else None),
+            continue_=query.get("continue") or None,
+        )
+        meta = {"resourceVersion": page["resourceVersion"]}
+        if page["continue"]:
+            meta["continue"] = page["continue"]
+        self._send(
+            200,
+            {
+                "apiVersion": api_version,
+                "kind": list_kind,
+                "metadata": meta,
+                "items": [to_json(o) for o in page["items"]],
+            },
+        )
+
     def _list_pods(self, namespace: str, query: dict) -> None:
         field_selector = query.get("fieldSelector", "")
         node_name = None
         for clause in field_selector.split(","):
             if clause.startswith("spec.nodeName="):
                 node_name = clause.split("=", 1)[1]
+        if node_name is None and (query.get("limit") or query.get("continue")):
+            # Chunked path (no fieldSelector composition needed by the
+            # engine's pagers).
+            return self._paged_list(
+                "Pod", "PodList", "v1", pod_to_json, namespace,
+                query.get("labelSelector", ""), query,
+            )
         items = self.store.list_pods(
             namespace=namespace,
             label_selector=query.get("labelSelector", ""),
@@ -596,6 +649,11 @@ class _Handler(BaseHTTPRequestHandler):
             {
                 "apiVersion": "v1",
                 "kind": "PodList",
+                "metadata": {
+                    "resourceVersion": str(
+                        self.store.current_resource_version()
+                    )
+                },
                 "items": [pod_to_json(p) for p in items],
             },
         )

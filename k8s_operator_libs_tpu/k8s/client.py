@@ -24,7 +24,9 @@ against envtest.
 
 from __future__ import annotations
 
+import base64
 import copy
+import json
 import queue
 import threading
 import time
@@ -54,6 +56,15 @@ class NotFoundError(KeyError):
 
 class ConflictError(RuntimeError):
     pass
+
+
+class ExpiredError(RuntimeError):
+    """HTTP 410 Gone: the requested resourceVersion (a watch resume point
+    or a list continue token) predates the server's retained history —
+    etcd compaction in a real cluster, the bounded watch cache here.  The
+    client-go informer contract on receiving this: throw away the resume
+    point, RE-LIST, and watch again from the fresh list's
+    resourceVersion."""
 
 
 class EvictionBlockedError(RuntimeError):
@@ -90,11 +101,15 @@ _HISTORY_CAP = 64
 @dataclass
 class WatchEvent:
     """One change notification: ADDED | MODIFIED | DELETED + a snapshot
-    of the object at mutation time (typed object for built-in kinds)."""
+    of the object at mutation time (typed object for built-in kinds).
+
+    ``rv``: the cluster resourceVersion assigned to this change — the
+    consumer's watch resume point (pass it back as ``since_rv``)."""
 
     type: str
     kind: str
     object: object
+    rv: int = 0
 
 
 class WatchSubscription:
@@ -124,19 +139,26 @@ class WatchSubscription:
 
 class _Store:
     """One kind's storage with per-key write history for cache-lag reads
-    and an optional change callback (the watch feed)."""
+    and an optional change callback (the watch feed).
 
-    def __init__(self, on_change=None) -> None:
+    ``next_rv`` draws from the cluster-wide revision counter: like etcd,
+    every write to ANY kind advances one shared sequence, and an object's
+    resourceVersion is the revision of its last write — which is what
+    makes a single list-envelope RV a valid resume point for watches over
+    every kind."""
+
+    def __init__(self, on_change=None, next_rv=None) -> None:
         self.objs: dict = {}
         # key -> [(monotonic_ts, snapshot-or-None)]; None = deleted
         self.history: dict = defaultdict(list)
         # Called as on_change(event_type, snapshot) with "ADDED" |
         # "MODIFIED" | "DELETED" after every mutation.
         self.on_change = on_change
+        self.next_rv = next_rv or _counter()
 
     def put(self, key, obj) -> None:
         event = "MODIFIED" if key in self.objs else "ADDED"
-        obj.metadata.resource_version += 1
+        obj.metadata.resource_version = self.next_rv()
         self.objs[key] = obj
         h = self.history[key]
         snap = deep_copy(obj)
@@ -149,8 +171,12 @@ class _Store:
     def delete(self, key) -> None:
         gone = self.objs.pop(key, None)
         self.history[key].append((time.monotonic(), None))
-        if gone is not None and self.on_change is not None:
-            self.on_change("DELETED", deep_copy(gone))
+        if gone is not None:
+            # A delete advances the cluster revision too; the DELETED
+            # event carries the object at its deletion revision.
+            gone.metadata.resource_version = self.next_rv()
+            if self.on_change is not None:
+                self.on_change("DELETED", deep_copy(gone))
 
     def get_live(self, key):
         return self.objs.get(key)
@@ -169,15 +195,46 @@ class _Store:
         return chosen
 
 
+def _counter():
+    """Standalone revision counter for a _Store used outside a cluster."""
+    state = {"rv": 0}
+
+    def next_rv() -> int:
+        state["rv"] += 1
+        return state["rv"]
+
+    return next_rv
+
+
 class FakeCluster:
     """In-memory apiserver + object store (see module docstring)."""
 
-    def __init__(self, api_latency_s: float = 0.0, cache_lag_s: float = 0.0):
+    def __init__(
+        self,
+        api_latency_s: float = 0.0,
+        cache_lag_s: float = 0.0,
+        watch_cache_size: int = 1024,
+    ):
         self._lock = threading.RLock()
-        self._nodes = _Store(self._make_notifier("Node"))
-        self._pods = _Store(self._make_notifier("Pod"))
-        self._daemon_sets = _Store(self._make_notifier("DaemonSet"))
-        self._revisions = _Store(self._make_notifier("ControllerRevision"))
+        # Cluster-wide revision counter (the etcd revision analogue):
+        # every write to any kind advances it; an object's
+        # resourceVersion is the revision of its last write.
+        self._rv = 0
+        # Bounded history of published watch events [(rv, WatchEvent)]:
+        # the watch cache.  Resume points older than its tail are GONE —
+        # the 410/relist behavior a real apiserver shows after etcd
+        # compaction.  ``_log_evicted_to``: highest rv already evicted.
+        self._watch_cache_size = max(int(watch_cache_size), 1)
+        self._event_log: list[tuple[int, WatchEvent]] = []
+        self._log_evicted_to = 0
+        self._nodes = _Store(self._make_notifier("Node"), self._next_rv)
+        self._pods = _Store(self._make_notifier("Pod"), self._next_rv)
+        self._daemon_sets = _Store(
+            self._make_notifier("DaemonSet"), self._next_rv
+        )
+        self._revisions = _Store(
+            self._make_notifier("ControllerRevision"), self._next_rv
+        )
         # Active watch subscriptions: list of (kinds-or-None, Queue).
         self._watchers: list[tuple[Optional[set], "queue.Queue"]] = []
         self.api_latency_s = api_latency_s
@@ -203,13 +260,44 @@ class FakeCluster:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _next_rv(self) -> int:
+        with self._lock:
+            self._rv += 1
+            return self._rv
+
+    @staticmethod
+    def _snapshot_rv(snapshot) -> int:
+        """resourceVersion of a watch-event object, typed or dict."""
+        if isinstance(snapshot, dict):
+            return int((snapshot.get("metadata") or {}).get(
+                "resourceVersion", 0
+            ))
+        return int(snapshot.metadata.resource_version)
+
+    def current_resource_version(self) -> int:
+        """The cluster's latest revision — what a real list envelope
+        carries in ``metadata.resourceVersion``; valid as a watch
+        ``since_rv`` resume point."""
+        with self._lock:
+            return self._rv
+
     def _notify(self, kind: str, event_type: str, snapshot) -> None:
-        for kinds, q in list(self._watchers):
+        with self._lock:
+            rv = self._snapshot_rv(snapshot)
+            event = WatchEvent(event_type, kind, snapshot, rv)
+            self._event_log.append((rv, event))
+            while len(self._event_log) > self._watch_cache_size:
+                evicted_rv, _ = self._event_log.pop(0)
+                self._log_evicted_to = evicted_rv
+            watchers = list(self._watchers)
+        for kinds, q in watchers:
             if kinds is None or kind in kinds:
                 # Fresh copy per delivery: a consumer mutating its event
                 # must not corrupt the cache-lag history snapshot or
                 # other subscribers' views.
-                q.put(WatchEvent(event_type, kind, copy.deepcopy(snapshot)))
+                q.put(
+                    WatchEvent(event_type, kind, copy.deepcopy(snapshot), rv)
+                )
 
     def _make_notifier(self, kind: str):
         def notify(event_type: str, snapshot) -> None:
@@ -217,16 +305,47 @@ class FakeCluster:
 
         return notify
 
-    def watch(self, kinds: Optional[Sequence[str]] = None) -> "WatchSubscription":
+    def watch(
+        self,
+        kinds: Optional[Sequence[str]] = None,
+        since_rv: Optional[int] = None,
+    ) -> "WatchSubscription":
         """Subscribe to object changes (the informer/watch analogue).
 
         ``kinds`` filters by kind name ("Node", "Pod", "DaemonSet",
         "ControllerRevision"); None = all.  Events carry a snapshot of
         the object at mutation time.  Close the subscription (or use it
-        as a context manager) to unsubscribe."""
+        as a context manager) to unsubscribe.
+
+        ``since_rv`` resumes from a resourceVersion (a prior list
+        envelope's RV or the last event's ``rv``): every retained event
+        with a higher rv is replayed first, then the live feed continues
+        — the watch-from-resourceVersion contract clients use to bridge
+        a reconnect without missing events.  Raises :class:`ExpiredError`
+        (410 Gone) when the resume point predates the bounded watch
+        cache; the caller must re-list and resume from the fresh RV."""
         q: "queue.Queue" = queue.Queue()
-        entry = (set(kinds) if kinds is not None else None, q)
+        kind_set = set(kinds) if kinds is not None else None
+        entry = (kind_set, q)
         with self._lock:
+            if since_rv is not None:
+                if since_rv < self._log_evicted_to:
+                    raise ExpiredError(
+                        f"too old resource version: {since_rv} "
+                        f"(oldest retained: {self._log_evicted_to + 1})"
+                    )
+                for rv, ev in self._event_log:
+                    if rv > since_rv and (
+                        kind_set is None or ev.kind in kind_set
+                    ):
+                        q.put(
+                            WatchEvent(
+                                ev.type,
+                                ev.kind,
+                                copy.deepcopy(ev.object),
+                                rv,
+                            )
+                        )
             self._watchers.append(entry)
         return WatchSubscription(self, entry)
 
@@ -235,7 +354,11 @@ class FakeCluster:
             if entry in self._watchers:
                 self._watchers.remove(entry)
 
-    def watch_events(self, kinds: Optional[Sequence[str]] = None):
+    def watch_events(
+        self,
+        kinds: Optional[Sequence[str]] = None,
+        since_rv: Optional[int] = None,
+    ):
         """Generator form of :meth:`watch`, yielding WatchEvents with
         periodic ``None`` heartbeats (so a consumer can check its stop
         flag while idle).  Same duck type as RestClient.watch_events —
@@ -243,12 +366,13 @@ class FakeCluster:
         "group/version/namespace/plural" (normalized to the plural,
         which is how CR watch events are keyed).
 
-        Note (informer semantics): there is no replay — events before
-        the subscription are not delivered.  Consumers pair this with a
-        periodic full resync, exactly like controller-runtime."""
+        ``since_rv=None``: live-only, no replay — pair with a periodic
+        full resync, exactly like controller-runtime.  With ``since_rv``
+        the retained history after that RV replays first (see
+        :meth:`watch`); :class:`ExpiredError` means re-list."""
         if kinds is not None:
             kinds = [k.split("/")[-1] if "/" in k else k for k in kinds]
-        sub = self.watch(kinds)
+        sub = self.watch(kinds, since_rv=since_rv)
         try:
             while True:
                 yield sub.get(timeout_s=0.5)
@@ -359,6 +483,81 @@ class FakeCluster:
                 )
             self._nodes.put(name, node)
             return deep_copy(node)
+
+    # -- paginated list (the client-go chunked-list contract) ---------------
+
+    def list_page(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: str = "",
+        limit: Optional[int] = None,
+        continue_: Optional[str] = None,
+    ) -> dict:
+        """Chunked list with continue tokens (client-go pagination).
+
+        Returns ``{"items", "resourceVersion", "continue"}``.  Items are
+        served in (namespace, name) key order — how etcd pages a range
+        read.  ``continue`` is an opaque token; passing it back serves
+        the next chunk.  A token whose snapshot revision has aged out of
+        the retained history raises :class:`ExpiredError` (410 Gone,
+        reason Expired) and the caller must restart the list — the
+        failure mode a real apiserver shows when etcd compacts under a
+        slow pager.  (Unlike etcd, chunks after the first serve the
+        CURRENT state rather than the original snapshot; the conformance
+        properties consumers rely on — full coverage, no duplicates,
+        bounded chunks, expiry — hold.)"""
+        self._call("list_page")
+        with self._lock:
+            if kind == "Node":
+                objs = {
+                    ("", n.name): n
+                    for n in self._nodes.objs.values()
+                    if matches_selector(n.labels, label_selector)
+                }
+            elif kind == "Pod":
+                objs = {
+                    (p.namespace, p.name): p
+                    for p in self._pods.objs.values()
+                    if (not namespace or p.namespace == namespace)
+                    and matches_selector(p.labels, label_selector)
+                }
+            else:
+                raise NotFoundError(f"list_page: unsupported kind {kind}")
+            if continue_:
+                try:
+                    token = json.loads(
+                        base64.urlsafe_b64decode(continue_.encode()).decode()
+                    )
+                    snapshot_rv = int(token["rv"])
+                    after = tuple(token["after"])
+                except (ValueError, KeyError, TypeError) as exc:
+                    raise InvalidError(
+                        f"malformed continue token: {exc}"
+                    ) from exc
+                if snapshot_rv < self._log_evicted_to:
+                    raise ExpiredError(
+                        "The provided continue parameter is too old to "
+                        "display a consistent list result. You must start "
+                        "a new list without the continue parameter."
+                    )
+            else:
+                snapshot_rv = self._rv
+                after = None
+            keys = sorted(k for k in objs if after is None or k > after)
+            page = keys if limit is None else keys[: max(int(limit), 0)]
+            next_token = None
+            if limit is not None and len(keys) > len(page) and page:
+                next_token = base64.urlsafe_b64encode(
+                    json.dumps(
+                        {"rv": snapshot_rv, "after": list(page[-1])}
+                    ).encode()
+                ).decode()
+            return {
+                "items": [deep_copy(objs[k]) for k in page],
+                "resourceVersion": str(snapshot_rv),
+                "continue": next_token,
+            }
 
     # -- pods --------------------------------------------------------------
 
@@ -631,7 +830,7 @@ class FakeCluster:
             meta = stored.setdefault("metadata", {})
             meta["namespace"] = namespace
             meta["uid"] = f"uid-{uuid.uuid4().hex[:12]}"
-            meta["resourceVersion"] = "1"
+            meta["resourceVersion"] = str(self._next_rv())
             self._custom[key] = stored
             # Watch feed keys custom resources by their plural.
             self._notify(plural, "ADDED", copy.deepcopy(stored))
@@ -688,7 +887,7 @@ class FakeCluster:
         meta = stored.setdefault("metadata", {})
         meta["namespace"] = namespace
         meta["uid"] = current["metadata"]["uid"]
-        meta["resourceVersion"] = str(int(cur_rv) + 1)
+        meta["resourceVersion"] = str(self._next_rv())
         self._custom[key] = stored
         self._notify(plural, "MODIFIED", copy.deepcopy(stored))
         return copy.deepcopy(stored)
@@ -727,7 +926,13 @@ class FakeCluster:
             if key not in self._custom:
                 raise NotFoundError(f"{plural} {namespace}/{name} not found")
             gone = self._custom.pop(key)
-            self._notify(plural, "DELETED", copy.deepcopy(gone))
+            # The delete advances the cluster revision (etcd semantics);
+            # the DELETED event carries the deletion revision.
+            gone = copy.deepcopy(gone)
+            gone.setdefault("metadata", {})["resourceVersion"] = str(
+                self._next_rv()
+            )
+            self._notify(plural, "DELETED", gone)
 
     def list_custom_objects(
         self, group: str, version: str, plural: str, namespace: str = ""

@@ -36,6 +36,7 @@ from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import (
     ConflictError,
     EvictionBlockedError,
+    ExpiredError,
     InvalidError,
     NotFoundError,
     ThrottledError,
@@ -573,6 +574,10 @@ class RestClient:
             raise NotFoundError(f"{method} {path}: {detail}")
         if status == 409:
             raise ConflictError(f"{method} {path}: {detail}")
+        if status == 410:
+            # Gone/Expired: stale list continue token (or watch resume
+            # point) — restart the list, re-list + re-watch.
+            raise ExpiredError(f"{method} {path}: {detail}")
         if status == 422:
             causes = []
             try:
@@ -618,6 +623,45 @@ class RestClient:
             "GET", "/api/v1/nodes", {"labelSelector": label_selector}
         )
         return [node_from_json(i) for i in out.get("items", [])]
+
+    def list_page(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: str = "",
+        limit: Optional[int] = None,
+        continue_: Optional[str] = None,
+    ) -> dict:
+        """Chunked list (same duck type as FakeCluster.list_page):
+        ``{"items", "resourceVersion", "continue"}``.  An expired
+        continue token raises :class:`ExpiredError` — restart the list
+        (client-go pager semantics)."""
+        if kind == "Node":
+            path, parse = "/api/v1/nodes", node_from_json
+        elif kind == "Pod":
+            path = (
+                f"/api/v1/namespaces/{namespace}/pods"
+                if namespace
+                else "/api/v1/pods"
+            )
+            parse = pod_from_json
+        else:
+            raise NotFoundError(f"list_page: unsupported kind {kind}")
+        out = self._request(
+            "GET",
+            path,
+            {
+                "labelSelector": label_selector,
+                "limit": str(limit) if limit is not None else "",
+                "continue": continue_ or "",
+            },
+        )
+        meta = out.get("metadata") or {}
+        return {
+            "items": [parse(i) for i in out.get("items", [])],
+            "resourceVersion": meta.get("resourceVersion", "0"),
+            "continue": meta.get("continue") or None,
+        }
 
     def patch_node_labels(
         self, name: str, patch: dict[str, Optional[str]]
@@ -842,15 +886,24 @@ class RestClient:
 
     # -- watch --------------------------------------------------------------
 
-    def watch_events(self, kinds: Optional[Sequence[str]] = None):
+    def watch_events(
+        self,
+        kinds: Optional[Sequence[str]] = None,
+        since_rv: Optional[int] = None,
+    ):
         """Generator of WatchEvents from the apiserver's streaming watch,
         with ``None`` heartbeats while idle (same duck type as
         FakeCluster.watch_events).  ``kinds``: which watch streams to
-        open; None = nodes + pods + daemonsets.  No pre-subscription
-        replay — pair with periodic resync (controller-runtime informer
-        semantics).  Each watched kind holds one dedicated connection
-        outside the keep-alive pool.
-        """
+        open; None = nodes + pods + daemonsets.  Each watched kind holds
+        one dedicated connection outside the keep-alive pool.
+
+        ``since_rv``: watch-from-resourceVersion resume point — the
+        server replays retained events after it before going live; a
+        compacted-away RV surfaces as :class:`ExpiredError` from the
+        generator (the 410 informer reconnect contract: re-list, then
+        re-watch from the fresh RV).  Without it there is no replay —
+        pair with periodic resync (controller-runtime informer
+        semantics)."""
         kinds = list(kinds) if kinds is not None else [
             "Node", "Pod", "DaemonSet",
         ]
@@ -888,8 +941,18 @@ class RestClient:
                 token = self._current_token()
                 if token:
                     headers["Authorization"] = f"Bearer {token}"
-                conn.request("GET", f"{path}?watch=true", headers=headers)
+                target = f"{path}?watch=true"
+                if since_rv is not None:
+                    target += f"&resourceVersion={int(since_rv)}"
+                conn.request("GET", target, headers=headers)
                 resp = conn.getresponse()
+                if resp.status == 410:
+                    # Expired resume point: the informer contract says
+                    # re-list + re-watch from the fresh RV.
+                    raise ExpiredError(
+                        f"watch {path} from rv {since_rv}: "
+                        f"{resp.read(512).decode(errors='replace')}"
+                    )
                 if resp.status != 200:
                     raise RuntimeError(
                         f"watch {path} -> {resp.status}: "
@@ -918,11 +981,30 @@ class RestClient:
                         continue
                     d = json.loads(line)
                     obj = d.get("object")
+                    if d.get("type") == "ERROR":
+                        # Mid-stream error envelope (real apiservers send
+                        # a Status object; 410 = resume point expired).
+                        code = (obj or {}).get("code")
+                        msg = (obj or {}).get("message", "")
+                        if code == 410:
+                            raise ExpiredError(f"watch {path}: {msg}")
+                        raise RuntimeError(
+                            f"watch {path} ERROR {code}: {msg}"
+                        )
+                    try:
+                        rv = int(
+                            ((obj or {}).get("metadata") or {}).get(
+                                "resourceVersion", 0
+                            )
+                        )
+                    except (TypeError, ValueError):
+                        rv = 0
                     events.put(
                         WatchEvent(
                             d.get("type", ""),
                             event_kind,
                             parser(obj) if parser else obj,
+                            rv,
                         )
                     )
             except Exception as e:  # noqa: BLE001 — surfaced to consumer

@@ -301,18 +301,29 @@ def test_watch_pump_reconnects_after_stream_error():
     )
     attempts = {"n": 0}
 
-    def flaky_watch_events(kinds=None):
+    def flaky_watch_events(kinds=None, since_rv=None):
+        from k8s_operator_libs_tpu.k8s.client import WatchEvent
+
         attempts["n"] += 1
         if attempts["n"] == 1:
             raise RuntimeError("stream broke")
         yield None
         while True:
-            ev = object()
-            yield ev
+            yield WatchEvent("MODIFIED", "Node", make_node("flaky"), 1)
             time.sleep(0.01)
 
     controller.client = type(
-        "FlakyClient", (), {"watch_events": staticmethod(flaky_watch_events)}
+        "FlakyClient",
+        (),
+        {
+            "watch_events": staticmethod(flaky_watch_events),
+            # The pump's list-then-watch baseline.
+            "list_page": staticmethod(
+                lambda kind, limit=None: {
+                    "items": [], "resourceVersion": "0", "continue": None,
+                }
+            ),
+        },
     )()
     wake = threading.Event()
     thread = threading.Thread(
