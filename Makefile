@@ -3,9 +3,16 @@
 # Python/JAX stack.
 
 PYTHON ?= python
+DOCKER ?= docker
+# The runtime image tag config/manifests/controller.yaml references
+# (k8s_operator_libs_tpu/manifests.py DEFAULT_IMAGE) — `make
+# docker-build` produces exactly what `kubectl apply` pulls.
+IMAGE ?= tpu-operator-libs
+TAG ?= latest
+BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
-.PHONY: all test test-fast lint cov-report bench graft-check clean \
-	generate generate-check
+.PHONY: all test test-fast lint typecheck cov-report bench graft-check \
+	clean generate generate-check docker-build docker-push .build-image
 
 all: lint test
 
@@ -42,6 +49,18 @@ lint:
 		$(PYTHON) -m ruff check k8s_operator_libs_tpu tests tools examples; \
 	fi
 
+# Static check of the typed client boundary (KubeClient Protocol,
+# k8s/interface.py).  mypy is not baked into every dev image; the
+# runtime conformance tests (tests/test_client_interface.py) are the
+# always-on gate, this is the CI-side static one.
+typecheck:
+	$(PYTHON) -m mypy --ignore-missing-imports \
+		--follow-imports=silent \
+		k8s_operator_libs_tpu/k8s/interface.py \
+		k8s_operator_libs_tpu/k8s/client.py \
+		k8s_operator_libs_tpu/k8s/rest.py \
+		k8s_operator_libs_tpu/upgrade/
+
 # Line coverage via the in-repo sys.monitoring runner; fails the build
 # under the threshold (reference parity: ci.yaml:50-66 coverage gate).
 COV_THRESHOLD ?= 90
@@ -59,3 +78,30 @@ graft-check:
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
 	rm -rf .pytest_cache
+
+# -- container images (reference Makefile:94-121 analogue) -------------------
+
+# Runtime image for controller/agent/status/safe-load-init; the install
+# manifests reference $(IMAGE):$(TAG).
+docker-build:
+	$(DOCKER) build --progress=plain \
+		--tag $(IMAGE):$(TAG) \
+		-f docker/Dockerfile .
+
+docker-push:
+	$(DOCKER) push $(IMAGE):$(TAG)
+
+# Devel image + containerized make targets: `make docker-lint`,
+# `make docker-test`, ... run the target inside the devel image with the
+# tree bind-mounted (reference's $(DOCKER_TARGETS) pattern).
+.build-image: docker/Dockerfile.devel
+	$(DOCKER) build --progress=plain \
+		--tag $(BUILDIMAGE) \
+		-f docker/Dockerfile.devel .
+
+docker-%: .build-image
+	@echo "Running 'make $(*)' in $(BUILDIMAGE)"
+	$(DOCKER) run --rm \
+		-v $(PWD):/workspace -w /workspace \
+		--user $$(id -u):$$(id -g) \
+		$(BUILDIMAGE) make $(*)
