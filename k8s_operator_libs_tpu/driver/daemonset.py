@@ -214,6 +214,13 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
     # gates on it).  In a JobSet deployment these are the peer slices'
     # headless-service addresses.
     dcn_peers: tuple[str, ...] = ()
+    # This pool's DCN group name plus every group expected in the
+    # cross-slice jax.distributed world; when both are set the agents run
+    # the dcn_collective check — a cross-slice XLA psum, the gate the
+    # north star asks for ("XLA all-reduce reachability") and strictly
+    # stronger than TCP reachability.
+    dcn_group: str = ""
+    dcn_expected_groups: tuple[str, ...] = ()
 
     # RollingUpdate is the point: a template change (new DRIVER_REVISION)
     # must restart the agent pods, or they would keep publishing reports
@@ -239,6 +246,17 @@ class AgentDaemonSetSpec(DriverDaemonSetSpec):
         if self.dcn_peers:
             env.append(
                 {"name": "HEALTH_DCN_PEERS", "value": ",".join(self.dcn_peers)}
+            )
+        if self.dcn_group:
+            env.append(
+                {"name": "HEALTH_DCN_GROUP", "value": self.dcn_group}
+            )
+        if self.dcn_expected_groups:
+            env.append(
+                {
+                    "name": "HEALTH_DCN_GROUPS",
+                    "value": ",".join(self.dcn_expected_groups),
+                }
             )
         pod["containers"] = [
             {

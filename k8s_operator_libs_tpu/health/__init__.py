@@ -23,6 +23,8 @@ in consumer operators.  Here the health check is in-repo and TPU-native:
 from k8s_operator_libs_tpu.health.probes import (
     CheckResult,
     device_inventory,
+    dcn_collective_probe,
+    dcn_reachability_probe,
     hbm_bandwidth_probe,
     ici_allreduce_probe,
     ici_ring_attention_probe,
@@ -46,6 +48,8 @@ __all__ = [
     "LocalDeviceProber",
     "NodeReportProber",
     "device_inventory",
+    "dcn_collective_probe",
+    "dcn_reachability_probe",
     "hbm_bandwidth_probe",
     "ici_allreduce_probe",
     "ici_ring_attention_probe",

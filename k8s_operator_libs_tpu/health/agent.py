@@ -113,6 +113,8 @@ class HealthAgent:
         deep: bool = False,
         max_iters: Optional[int] = None,
         dcn_peers: Optional[Sequence[str]] = None,
+        dcn_group: str = "",
+        dcn_expected_groups: Optional[Sequence[str]] = None,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -135,6 +137,14 @@ class HealthAgent:
         # "host[:port]" peer-slice endpoints across the DCN; when set the
         # battery includes dcn_reachability (BASELINE config 5).
         self.dcn_peers = list(dcn_peers) if dcn_peers else None
+        # This host's DCN group + the groups expected in the collective
+        # world; when set the battery includes dcn_collective — the
+        # cross-slice XLA all-reduce the health gate prefers over TCP
+        # reachability (north star: "XLA all-reduce reachability").
+        self.dcn_group = dcn_group
+        self.dcn_expected_groups = (
+            list(dcn_expected_groups) if dcn_expected_groups else None
+        )
 
     def probe_once(self) -> HealthReport:
         kwargs = {} if self.max_iters is None else {"max_iters": self.max_iters}
@@ -145,6 +155,8 @@ class HealthAgent:
             allreduce_elems=self.allreduce_elems,
             deep=self.deep,
             dcn_peers=self.dcn_peers,
+            dcn_group=self.dcn_group,
+            dcn_expected_groups=self.dcn_expected_groups,
             **kwargs,
         )
         # Derive the visible-device count from the enumeration check
@@ -194,6 +206,18 @@ class HealthAgent:
             time.sleep(interval_s)
 
 
+def csv_env(name: str) -> Optional[list]:
+    """Comma-separated env var -> stripped non-empty entries, or None.
+
+    Shared by this entrypoint and the multihost test worker (which
+    exists to model THIS agent — parsing drift between them would
+    silently change what the test exercises)."""
+    entries = [
+        e.strip() for e in os.environ.get(name, "").split(",") if e.strip()
+    ]
+    return entries or None
+
+
 def main() -> None:
     """Entrypoint for the agent container:
     ``python -m k8s_operator_libs_tpu.health.agent``."""
@@ -209,12 +233,9 @@ def main() -> None:
         driver_revision=os.environ.get(DRIVER_REVISION_ENV, ""),
         slice_wide=slice_wide,
         deep=os.environ.get("HEALTH_DEEP_PROBE", "") == "1",
-        dcn_peers=[
-            p.strip()
-            for p in os.environ.get("HEALTH_DCN_PEERS", "").split(",")
-            if p.strip()
-        ]
-        or None,
+        dcn_peers=csv_env("HEALTH_DCN_PEERS"),
+        dcn_group=os.environ.get("HEALTH_DCN_GROUP", ""),
+        dcn_expected_groups=csv_env("HEALTH_DCN_GROUPS"),
     )
     interval = float(os.environ.get("HEALTH_PROBE_INTERVAL_S", "30"))
     agent.run_forever(interval)

@@ -814,6 +814,106 @@ def dcn_reachability_probe(
     )
 
 
+def dcn_collective_probe(
+    devices: Optional[Sequence[jax.Device]] = None,
+    dcn_group: str = "",
+    expected_groups: Optional[Sequence[str]] = None,
+) -> CheckResult:
+    """A cross-slice XLA all-reduce over the DCN — the north star's
+    "XLA all-reduce reachability", strictly stronger than
+    :func:`dcn_reachability_probe`: a port can answer while the
+    collective transport is broken (stale gRPC state, a peer slice that
+    never joined the world, an asymmetric route), and only a COMPLETING
+    psum whose result carries every peer slice's contribution proves the
+    multi-slice JobSet can actually step.
+
+    Every process contributes a one-hot vector over the sorted expected
+    DCN group names at its own group's index; after a ``psum`` across
+    the full ``jax.distributed`` world, entry g is the number of devices
+    whose host claims group g.  Verdict: every expected group
+    contributed at least once.  A peer slice that is reachable by TCP
+    but absent from the collective world shows up as a zero — the exact
+    failure the TCP probe cannot see."""
+    try:
+        devs = list(devices) if devices is not None else list(jax.devices())
+    except RuntimeError as e:
+        return CheckResult(
+            "dcn_collective", False, 0.0, f"device enumeration failed: {e}"
+        )
+    if not dcn_group:
+        return CheckResult(
+            "dcn_collective", False, 0.0,
+            "no DCN group configured for this host (HEALTH_DCN_GROUP)",
+        )
+    groups = sorted(set(expected_groups or ()) | {dcn_group})
+    if len(groups) < 2:
+        return CheckResult(
+            "dcn_collective", False, 0.0,
+            f"need >=2 expected DCN groups, have {groups} — a single-group "
+            "collective proves nothing about the DCN",
+        )
+    n = len(devs)
+    n_processes = len({d.process_index for d in devs})
+    if n_processes < 2:
+        return CheckResult(
+            "dcn_collective", False, 0.0,
+            f"distributed world spans {n_processes} process(es); the "
+            "cross-slice world never formed",
+            metrics={"processes": float(n_processes)},
+        )
+    mesh = Mesh(np.asarray(devs), ("dcn",))
+    onehot = np.zeros(len(groups), dtype=np.float32)
+    onehot[groups.index(dcn_group)] = 1.0
+    # Each process materializes only ITS addressable rows, filled with
+    # ITS group's one-hot; remote rows come from their own processes.
+    host = np.tile(onehot, (n, 1))
+
+    def body(x):
+        return jax.lax.psum(x, "dcn")
+
+    t0 = time.perf_counter()
+    try:
+        x = jax.make_array_from_callback(
+            host.shape,
+            NamedSharding(mesh, P("dcn")),
+            lambda idx: host[idx],
+        )
+        fn = jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=P("dcn"), out_specs=P())
+        )
+        counts = np.asarray(
+            _addressable_numpy(jax.block_until_ready(fn(x)))
+        ).reshape(-1)[: len(groups)]
+    except Exception as e:  # noqa: BLE001 — a broken DCN raises mid-psum
+        return CheckResult(
+            "dcn_collective", False,
+            (time.perf_counter() - t0) * 1e3,
+            f"cross-slice psum failed: {e}",
+        )
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    contributions = {g: int(c) for g, c in zip(groups, counts)}
+    missing = [g for g, c in contributions.items() if c < 1]
+    detail = "cross-slice psum completed; contributions: " + " ".join(
+        f"{g}={c}" for g, c in contributions.items()
+    )
+    if missing:
+        detail = (
+            "DCN collective missing contribution(s) from: "
+            + ", ".join(missing) + "; " + detail
+        )
+    return CheckResult(
+        "dcn_collective",
+        not missing,
+        elapsed_ms,
+        detail,
+        metrics={
+            "groups": float(len(groups)),
+            "participating": float(len(groups) - len(missing)),
+            "processes": float(n_processes),
+        },
+    )
+
+
 def run_host_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
@@ -825,6 +925,8 @@ def run_host_probe(
     min_time_s: float = DEFAULT_MIN_TIME_S,
     max_iters: int = _MAX_SUSTAINED_ITERS,
     dcn_peers: Optional[Sequence[str]] = None,
+    dcn_group: str = "",
+    dcn_expected_groups: Optional[Sequence[str]] = None,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
 
@@ -882,4 +984,15 @@ def run_host_probe(
             results.append(ici_ring_attention_probe(devs))
     if dcn_peers:
         results.append(dcn_reachability_probe(dcn_peers))
+    if dcn_expected_groups:
+        # The collective gate (north star: "XLA all-reduce reachability")
+        # — runs over the full jax.distributed world and proves every
+        # peer DCN group's contribution lands; reachability above stays
+        # as the cheap attribution aid when both are configured.
+        results.append(
+            dcn_collective_probe(
+                devs, dcn_group=dcn_group,
+                expected_groups=dcn_expected_groups,
+            )
+        )
     return results

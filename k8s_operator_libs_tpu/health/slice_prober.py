@@ -111,12 +111,14 @@ class NodeReportProber:
         self.min_hbm_gbps = min_hbm_gbps
         self.min_ici_busbw_gbps = min_ici_busbw_gbps
         self.hbm_floor_fraction = hbm_floor_fraction
-        # Require a dcn_reachability check in every report for groups that
-        # belong to a DCN (multi-slice) group.  Pushed from
-        # SliceHealthGateSpec.dcn_check by apply_state; a failed DCN check
-        # already rejects via the generic failed-checks path — this flag
-        # additionally rejects reports that MISSED the check (agent not
-        # configured with peers), so "gate on DCN" can't silently no-op.
+        # Require a DCN check (dcn_collective — the cross-slice XLA
+        # all-reduce — or the TCP dcn_reachability fallback) in every
+        # report for groups that belong to a DCN (multi-slice) group.
+        # Pushed from SliceHealthGateSpec.dcn_check by apply_state; a
+        # failed DCN check already rejects via the generic failed-checks
+        # path — this flag additionally rejects reports that MISSED the
+        # check (agent not configured), so "gate on DCN" can't silently
+        # no-op.
         self.require_dcn_check = False
 
     def _required_revision(self, group: UpgradeGroup) -> str:
@@ -188,12 +190,15 @@ class NodeReportProber:
             self.require_dcn_check
             and group.slice_info is not None
             and group.slice_info.dcn_group is not None
-            and not any(c.name == "dcn_reachability" for c in report.checks)
+            and not any(
+                c.name in ("dcn_collective", "dcn_reachability")
+                for c in report.checks
+            )
         ):
             return (
                 "dcn_check is enabled but the report carries no "
-                "dcn_reachability check (agent not configured with "
-                "HEALTH_DCN_PEERS?)"
+                "dcn_collective/dcn_reachability check (agent not "
+                "configured with HEALTH_DCN_GROUP(S)/HEALTH_DCN_PEERS?)"
             )
         for check in report.checks:
             # A check with no measured figure (timing_inconclusive: host

@@ -28,6 +28,7 @@ jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 from k8s_operator_libs_tpu.health.agent import (  # noqa: E402
     HealthAgent,
+    csv_env,
     maybe_initialize_distributed,
 )
 from k8s_operator_libs_tpu.k8s import KubeConfig, RestClient  # noqa: E402
@@ -51,6 +52,12 @@ def main() -> None:
         hbm_mib=1,
         allreduce_elems=256,
         deep=os.environ.get("HEALTH_DEEP_PROBE", "") == "1",
+        # DCN collective config: each worker process models one slice of
+        # a multi-slice JobSet; the cross-process gloo psum then IS a
+        # cross-slice DCN collective.
+        dcn_peers=csv_env("HEALTH_DCN_PEERS"),
+        dcn_group=os.environ.get("HEALTH_DCN_GROUP", ""),
+        dcn_expected_groups=csv_env("HEALTH_DCN_GROUPS"),
     )
     report = agent.run_once()
     print(

@@ -72,6 +72,7 @@ def test_agent_daemon_set_shape():
     spec = AgentDaemonSetSpec(
         version="1.0", driver_revision="rev-7", probe_interval_s=15.0,
         deep=True, dcn_peers=("peer-0.slice-b:8471", "peer-0.slice-c"),
+        dcn_group="ring-a", dcn_expected_groups=("ring-a", "ring-b"),
     )
     ds = build_daemon_set(spec)
     pod = ds.spec.template.pod_spec
@@ -82,6 +83,8 @@ def test_agent_daemon_set_shape():
     assert env["HEALTH_PROBE_INTERVAL_S"] == "15.0"
     assert env["HEALTH_DEEP_PROBE"] == "1"
     assert env["HEALTH_DCN_PEERS"] == "peer-0.slice-b:8471,peer-0.slice-c"
+    assert env["HEALTH_DCN_GROUP"] == "ring-a"
+    assert env["HEALTH_DCN_GROUPS"] == "ring-a,ring-b"
     # Must keep probing cordoned hosts mid-upgrade.
     assert any(
         t["key"] == "node.kubernetes.io/unschedulable"
