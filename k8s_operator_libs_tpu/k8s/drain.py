@@ -22,10 +22,10 @@ from typing import Callable, Optional
 
 from k8s_operator_libs_tpu.k8s.client import (
     EvictionBlockedError,
-    FakeCluster,
     NotFoundError,
     ThrottledError,
 )
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import Node, Pod
 
 
@@ -56,7 +56,7 @@ class DrainHelper:
 
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         force: bool = False,
         ignore_all_daemon_sets: bool = True,
         delete_empty_dir_data: bool = False,

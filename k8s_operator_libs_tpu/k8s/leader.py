@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import ConflictError, NotFoundError
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 
 logger = get_logger(__name__)
 
@@ -81,7 +82,7 @@ class LeaderElector:
 
     def __init__(
         self,
-        client,
+        client: KubeClient,
         identity: Optional[str] = None,
         namespace: str = "kube-system",
         name: str = "tpu-upgrade-controller",

@@ -18,6 +18,7 @@ import argparse
 import json as _json
 from typing import Optional
 
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.upgrade.upgrade_state import (
     BuildStateError,
     ClusterUpgradeStateManager,
@@ -26,7 +27,7 @@ from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
 
 
 def gather(
-    client,
+    client: KubeClient,
     namespace: str,
     driver_labels: dict[str, str],
     keys: Optional[UpgradeKeys] = None,

@@ -10,14 +10,14 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper
 from k8s_operator_libs_tpu.k8s.objects import Node
 from k8s_operator_libs_tpu.upgrade.util import run_batch
 
 
 class CordonManager:
-    def __init__(self, client: FakeCluster, max_concurrency: int = 32) -> None:
+    def __init__(self, client: KubeClient, max_concurrency: int = 32) -> None:
         self.client = client
         self.max_concurrency = max_concurrency
 

@@ -24,7 +24,7 @@ from typing import Optional, Sequence
 
 from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.drain import DrainError, DrainHelper
 from k8s_operator_libs_tpu.k8s.objects import Node
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
@@ -57,7 +57,7 @@ class DrainConfiguration:
 class DrainManager:
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         node_state_provider: NodeUpgradeStateProvider,
         keys: UpgradeKeys,
         event_recorder: Optional[EventRecorder] = None,

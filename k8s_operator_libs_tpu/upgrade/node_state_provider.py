@@ -22,7 +22,8 @@ import time
 from typing import Optional, Sequence
 
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.k8s.client import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s.client import NotFoundError
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import Node
 from k8s_operator_libs_tpu.upgrade.consts import NULL_STRING, UpgradeState
 from k8s_operator_libs_tpu.upgrade.util import (
@@ -47,7 +48,7 @@ class NodeUpgradeStateProvider:
 
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         keys: UpgradeKeys,
         event_recorder: Optional[EventRecorder] = None,
         poll_interval_s: float = 1.0,

@@ -30,7 +30,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     WaitForCompletionSpec,
 )
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Pod, PodPhase
 from k8s_operator_libs_tpu.k8s.selectors import selector_from_match_labels
@@ -75,7 +75,7 @@ class PodManagerConfig:
 class PodManager:
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         node_state_provider: NodeUpgradeStateProvider,
         keys: UpgradeKeys,
         pod_deletion_filter: Optional[PodDeletionFilter] = None,

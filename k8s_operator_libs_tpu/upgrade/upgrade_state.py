@@ -30,7 +30,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     TPUUpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
@@ -83,7 +83,7 @@ class ClusterUpgradeStateManager:
 
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         keys: Optional[UpgradeKeys] = None,
         event_recorder: Optional[EventRecorder] = None,
         node_state_provider: Optional[NodeUpgradeStateProvider] = None,

@@ -25,7 +25,7 @@ from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.k8s.client import FakeCluster
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import Pod, PodPhase
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.upgrade.node_state_provider import (
@@ -63,7 +63,7 @@ class PodValidationProber:
     """Reference-parity prober: validation pods Ready on every host
     (validation_manager.go:71-136)."""
 
-    def __init__(self, client: FakeCluster, pod_selector: str) -> None:
+    def __init__(self, client: KubeClient, pod_selector: str) -> None:
         self.client = client
         self.pod_selector = pod_selector
 
@@ -95,7 +95,7 @@ class PodValidationProber:
 class ValidationManager:
     def __init__(
         self,
-        client: FakeCluster,
+        client: KubeClient,
         node_state_provider: NodeUpgradeStateProvider,
         keys: UpgradeKeys,
         prober: Optional[SliceProber] = None,
