@@ -613,7 +613,7 @@ class UpgradeController:
                     for k in kinds
                 }
                 for ev in self.client.watch_events(
-                    kinds, since_rv=resume_rv
+                    kinds, since_rv=resume_rv, bookmarks=True
                 ):
                     if self._stop:
                         return
@@ -625,7 +625,10 @@ class UpgradeController:
                     if ev is not None:
                         if ev.rv and ev.kind in floors:
                             floors[ev.kind] = max(floors[ev.kind], ev.rv)
-                        wake.set()
+                        # BOOKMARKs advance resume points on quiet kinds
+                        # (no reconcile-worthy change happened).
+                        if ev.type != "BOOKMARK":
+                            wake.set()
             except ExpiredError as e:
                 logger.warning(
                     "watch resume point expired (%s); re-listing via an "

@@ -280,6 +280,9 @@ class _Handler(BaseHTTPRequestHandler):
         # wire tier and FakeCluster behave identically.)
         raw_rv = query.get("resourceVersion", "")
         self._since_rv = int(raw_rv) if raw_rv else None
+        # allowWatchBookmarks=true: idle heartbeats may carry BOOKMARK
+        # envelopes advancing the client's safe resume point.
+        self._bookmarks = query.get("allowWatchBookmarks") == "true"
         # /api/v1/nodes[/{name}]
         if parts[:2] == ["api", "v1"] and len(parts) >= 3 and parts[2] == "nodes":
             if len(parts) == 3:
@@ -463,14 +466,45 @@ class _Handler(BaseHTTPRequestHandler):
         Without a resume point there is no replay — clients pair watches
         with periodic resync, like controller-runtime informers."""
         sub = self.store.watch(kinds, since_rv=self._since_rv)
+        bookmarked = self._since_rv or 0
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             while not self.stopping.is_set():
+                # Snapshot BEFORE the timed get (an empty queue over the
+                # window proves every event <= snapshot was delivered, so
+                # the snapshot is a safe BOOKMARK resume point).  Skipped
+                # on non-bookmark streams — no store-lock traffic on the
+                # default hot path.
+                snapshot = (
+                    self.store.current_resource_version()
+                    if self._bookmarks
+                    else 0
+                )
                 ev = sub.get(timeout_s=0.5)
                 if ev is None:
+                    if self._bookmarks and snapshot > bookmarked:
+                        bookmarked = snapshot
+                        for kind in kinds:
+                            self._write_chunk(
+                                json.dumps(
+                                    {
+                                        "type": "BOOKMARK",
+                                        "object": {
+                                            "kind": kind,
+                                            "metadata": {
+                                                "resourceVersion": str(
+                                                    snapshot
+                                                )
+                                            },
+                                        },
+                                    }
+                                ).encode()
+                                + b"\n"
+                            )
+                        continue
                     self._write_chunk(b"\n")  # heartbeat / liveness probe
                     continue
                 ns, labels = self._event_meta(ev.object)
@@ -479,7 +513,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if label_selector and not matches_selector(
                     labels, label_selector
                 ):
+                    # Filtered out: NOT delivered, so it must not advance
+                    # `bookmarked` — the next idle heartbeat then emits a
+                    # BOOKMARK covering it (a real apiserver's bookmarks
+                    # cover selector-filtered churn the same way).
                     continue
+                if ev.rv:
+                    bookmarked = max(bookmarked, ev.rv)
                 line = (
                     json.dumps(
                         {"type": ev.type, "object": to_json(ev.object)}

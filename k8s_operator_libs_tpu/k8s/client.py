@@ -358,6 +358,7 @@ class FakeCluster:
         self,
         kinds: Optional[Sequence[str]] = None,
         since_rv: Optional[int] = None,
+        bookmarks: bool = False,
     ):
         """Generator form of :meth:`watch`, yielding WatchEvents with
         periodic ``None`` heartbeats (so a consumer can check its stop
@@ -369,13 +370,54 @@ class FakeCluster:
         ``since_rv=None``: live-only, no replay — pair with a periodic
         full resync, exactly like controller-runtime.  With ``since_rv``
         the retained history after that RV replays first (see
-        :meth:`watch`); :class:`ExpiredError` means re-list."""
+        :meth:`watch`); :class:`ExpiredError` means re-list.
+
+        ``bookmarks=True`` (the allowWatchBookmarks contract): when the
+        cluster revision advances past everything this stream has
+        delivered, idle heartbeats carry BOOKMARK events (``object``
+        None, ``rv`` = a safe resume point) — one per watched kind —
+        so a consumer's resume point stays fresh on kinds that rarely
+        change and a reconnect doesn't 410 just because OTHER kinds
+        churned the watch cache."""
         if kinds is not None:
             kinds = [k.split("/")[-1] if "/" in k else k for k in kinds]
         sub = self.watch(kinds, since_rv=since_rv)
+        # Per KIND: one churning kind's delivered events must not
+        # suppress BOOKMARKs for a quiet kind (the quiet kind is exactly
+        # who needs them; also matches the wire tier, where each kind is
+        # its own stream).  kinds=None bookmarks the same built-in trio
+        # the wire tier's default streams cover.
+        marks = {
+            k: since_rv or 0
+            for k in (kinds if kinds is not None
+                      else ["Node", "Pod", "DaemonSet"])
+        }
         try:
             while True:
-                yield sub.get(timeout_s=0.5)
+                # Snapshot BEFORE the timed get: an empty queue over the
+                # get window proves every event <= snapshot was already
+                # delivered, so the snapshot is a safe bookmark.  (Only
+                # needed when bookmarking — skip the lock acquire on the
+                # default hot path.)
+                snapshot = (
+                    self.current_resource_version() if bookmarks else 0
+                )
+                ev = sub.get(timeout_s=0.5)
+                if ev is not None:
+                    if ev.rv and ev.kind in marks:
+                        marks[ev.kind] = max(marks[ev.kind], ev.rv)
+                    yield ev
+                    continue
+                if bookmarks:
+                    stale = [k for k, m in marks.items() if snapshot > m]
+                    if stale:
+                        for kind in stale:
+                            marks[kind] = snapshot
+                            yield WatchEvent(
+                                "BOOKMARK", kind, None, snapshot
+                            )
+                        continue
+                yield None
         finally:
             sub.close()
 

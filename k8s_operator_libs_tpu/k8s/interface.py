@@ -174,7 +174,9 @@ class KubeClient(Protocol):
         self,
         kinds: Optional[Sequence[str]] = None,
         since_rv: Optional[int] = None,
+        bookmarks: bool = False,
     ) -> Iterator[Optional[WatchEvent]]:
         """Change feed with None heartbeats; ``since_rv`` resumes with
-        replay or raises ExpiredError (410)."""
+        replay or raises ExpiredError (410); ``bookmarks`` opts into
+        BOOKMARK resume-point advances on idle streams."""
         ...

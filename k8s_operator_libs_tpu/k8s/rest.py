@@ -384,6 +384,9 @@ class RestClient:
     def __init__(self, config: KubeConfig, timeout_s: float = 30.0) -> None:
         self.config = config
         self.timeout_s = timeout_s
+        # Chunk size for full lists (client-go pager default); lowered in
+        # tests to exercise multi-chunk walks without thousand-node pools.
+        self.list_chunk_size = 500
         self.stats: Counter = Counter()
         self._token = config.token
         if not self._token and config.token_path:
@@ -619,10 +622,42 @@ class RestClient:
         return node_from_json(self._request("GET", f"/api/v1/nodes/{name}"))
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:
-        out = self._request(
-            "GET", "/api/v1/nodes", {"labelSelector": label_selector}
-        )
-        return [node_from_json(i) for i in out.get("items", [])]
+        return self._list_all_chunked("Node", "", label_selector)
+
+    def _list_all_chunked(
+        self, kind: str, namespace: str, label_selector: str
+    ) -> list:
+        """Full list via limit/continue chunks (the client-go pager:
+        500-item chunks by default) so a v5p-pool-scale list never asks
+        the apiserver for one giant response.  A continue token that
+        expires mid-walk (cluster churned past the retained history)
+        restarts the walk once from scratch — the pager's
+        full-relist fallback."""
+        for attempt in (1, 2):
+            items: list = []
+            continue_: Optional[str] = None
+            try:
+                while True:
+                    page = self.list_page(
+                        kind,
+                        namespace=namespace,
+                        label_selector=label_selector,
+                        limit=self.list_chunk_size,
+                        continue_=continue_,
+                    )
+                    items.extend(page["items"])
+                    continue_ = page["continue"]
+                    if not continue_:
+                        return items
+            except ExpiredError:
+                if attempt == 2:
+                    raise
+                logger.warning(
+                    "list %s: continue token expired mid-walk; "
+                    "restarting the chunked list",
+                    kind,
+                )
+        return items  # unreachable; loop returns or raises
 
     def list_page(
         self,
@@ -713,6 +748,11 @@ class RestClient:
         node_name: Optional[str] = None,
         match_labels: Optional[dict[str, str]] = None,
     ) -> list[Pod]:
+        if node_name is None:
+            # Chunked pager path (match_labels folds into the selector).
+            return self._list_all_chunked(
+                "Pod", namespace, _label_selector(label_selector, match_labels)
+            )
         path = (
             f"/api/v1/namespaces/{namespace}/pods"
             if namespace
@@ -721,8 +761,7 @@ class RestClient:
         query = {
             "labelSelector": _label_selector(label_selector, match_labels)
         }
-        if node_name is not None:
-            query["fieldSelector"] = f"spec.nodeName={node_name}"
+        query["fieldSelector"] = f"spec.nodeName={node_name}"
         out = self._request("GET", path, query)
         return [pod_from_json(i) for i in out.get("items", [])]
 
@@ -890,6 +929,7 @@ class RestClient:
         self,
         kinds: Optional[Sequence[str]] = None,
         since_rv: Optional[int] = None,
+        bookmarks: bool = False,
     ):
         """Generator of WatchEvents from the apiserver's streaming watch,
         with ``None`` heartbeats while idle (same duck type as
@@ -903,7 +943,11 @@ class RestClient:
         generator (the 410 informer reconnect contract: re-list, then
         re-watch from the fresh RV).  Without it there is no replay —
         pair with periodic resync (controller-runtime informer
-        semantics)."""
+        semantics).
+
+        ``bookmarks=True`` asks the server (allowWatchBookmarks) for
+        BOOKMARK events on idle streams — ``object`` None, ``rv`` a safe
+        resume point — keeping quiet kinds' resume points fresh."""
         kinds = list(kinds) if kinds is not None else [
             "Node", "Pod", "DaemonSet",
         ]
@@ -944,6 +988,8 @@ class RestClient:
                 target = f"{path}?watch=true"
                 if since_rv is not None:
                     target += f"&resourceVersion={int(since_rv)}"
+                if bookmarks:
+                    target += "&allowWatchBookmarks=true"
                 conn.request("GET", target, headers=headers)
                 resp = conn.getresponse()
                 if resp.status == 410:
@@ -981,6 +1027,20 @@ class RestClient:
                         continue
                     d = json.loads(line)
                     obj = d.get("object")
+                    try:
+                        rv = int(
+                            ((obj or {}).get("metadata") or {}).get(
+                                "resourceVersion", 0
+                            )
+                        )
+                    except (TypeError, ValueError):
+                        rv = 0
+                    if d.get("type") == "BOOKMARK":
+                        # Resume-point advance only; no object payload.
+                        events.put(
+                            WatchEvent("BOOKMARK", event_kind, None, rv)
+                        )
+                        continue
                     if d.get("type") == "ERROR":
                         # Mid-stream error envelope (real apiservers send
                         # a Status object; 410 = resume point expired).
@@ -991,14 +1051,6 @@ class RestClient:
                         raise RuntimeError(
                             f"watch {path} ERROR {code}: {msg}"
                         )
-                    try:
-                        rv = int(
-                            ((obj or {}).get("metadata") or {}).get(
-                                "resourceVersion", 0
-                            )
-                        )
-                    except (TypeError, ValueError):
-                        rv = 0
                     events.put(
                         WatchEvent(
                             d.get("type", ""),

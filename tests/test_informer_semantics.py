@@ -198,6 +198,166 @@ def test_expired_continue_token_raises_410(small_cache_tier):
         client.list_page("Node", limit=3, continue_=token)
 
 
+def test_rest_full_lists_walk_in_chunks():
+    """RestClient.list_nodes/list_pods page through limit/continue under
+    the hood (client-go pager), so a pool-scale list never requests one
+    giant response — and the result is still the complete set."""
+    store = FakeCluster()
+    server = KubeApiServer(store).start()
+    try:
+        client = RestClient(KubeConfig(host=server.host), timeout_s=5.0)
+        client.list_chunk_size = 10
+        for i in range(35):
+            store.create_node(make_node(f"ch-{i:02d}"))
+        before = store.stats["list_page"]
+        nodes = client.list_nodes()
+        assert sorted(n.name for n in nodes) == sorted(
+            f"ch-{i:02d}" for i in range(35)
+        )
+        # 35 nodes / 10-item chunks = 4 chunked requests.
+        assert store.stats["list_page"] - before == 4
+    finally:
+        server.stop()
+
+
+# -- watch bookmarks ----------------------------------------------------------
+
+
+def test_bookmarks_keep_quiet_kind_resume_points_fresh(small_cache_tier):
+    """The allowWatchBookmarks contract: while OTHER kinds churn the
+    (4-event) watch cache, an idle Pod stream receives BOOKMARK events
+    advancing its safe resume point — so a reconnect resumes cleanly
+    where the original baseline would 410."""
+    store, client = small_cache_tier.store, small_cache_tier.client
+    store.create_node(make_node("bk-0"))
+    baseline = store.current_resource_version()
+    gen = client.watch_events(["Pod"], since_rv=baseline, bookmarks=True)
+    # Generators are lazy: pull one heartbeat so the stream is actually
+    # subscribed BEFORE the churn (a real informer holds its stream
+    # open; connecting after the churn would be the 410 case below).
+    assert next(gen) is None
+    # Churn Nodes well past the cache; the Pod stream stays quiet.
+    for i in range(12):
+        store.patch_node_labels("bk-0", {"churn": str(i)})
+    bookmark = None
+    deadline = time.monotonic() + 10.0
+    for ev in gen:
+        if ev is not None and ev.type == "BOOKMARK":
+            bookmark = ev
+            break
+        assert time.monotonic() < deadline, "no BOOKMARK within 10s"
+    gen.close()
+    assert bookmark.object is None
+    assert bookmark.rv > baseline
+    # The advanced resume point reconnects cleanly...
+    relay = client.watch_events(["Pod"], since_rv=bookmark.rv)
+    store.create_node(make_node("bk-live"))  # any write; stream liveness
+    next(relay)
+    relay.close()
+    # ...where the stale baseline is already compacted away.
+    with pytest.raises(ExpiredError):
+        _collect(client.watch_events(["Pod"], since_rv=baseline), 1)
+
+
+def test_bookmarks_are_per_kind_on_a_merged_stream():
+    """A merged multi-kind subscription (the fake/sim tier shape): one
+    kind's delivered churn must not suppress the QUIET kind's
+    BOOKMARKs — the quiet kind is exactly who needs its resume point
+    kept fresh."""
+    store = FakeCluster(watch_cache_size=4)
+    store.create_node(make_node("mk-0"))
+    baseline = store.current_resource_version()
+    gen = store.watch_events(
+        ["Node", "Pod"], since_rv=baseline, bookmarks=True
+    )
+    assert next(gen) is None  # subscribed
+    for i in range(8):
+        store.patch_node_labels("mk-0", {"churn": str(i)})
+    pod_bookmark = None
+    deadline = time.monotonic() + 10.0
+    for ev in gen:
+        if ev is not None and ev.type == "BOOKMARK" and ev.kind == "Pod":
+            pod_bookmark = ev
+            break
+        assert time.monotonic() < deadline, "no Pod BOOKMARK within 10s"
+    gen.close()
+    assert pod_bookmark.rv > baseline
+
+
+def test_wire_bookmarks_cover_selector_filtered_churn():
+    """Server-side: events dropped by the request's labelSelector are
+    never delivered, so they must NOT advance the stream's bookmark
+    mark — the idle BOOKMARK is what carries the client's resume point
+    past them (real kube-apiserver behavior)."""
+    import http.client
+    import json as _json
+
+    from k8s_operator_libs_tpu.k8s.objects import ObjectMeta, Pod, PodSpec
+
+    store = FakeCluster(watch_cache_size=4)
+    server = KubeApiServer(store).start()
+    try:
+        baseline = store.current_resource_version()
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10.0)
+        conn.request(
+            "GET",
+            "/api/v1/pods?watch=true&allowWatchBookmarks=true"
+            f"&labelSelector=app%3Dwanted&resourceVersion={baseline}",
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # Churn pods the selector REJECTS.
+        for i in range(8):
+            store.create_pod(
+                Pod(
+                    metadata=ObjectMeta(
+                        name=f"noise-{i}", namespace="default",
+                        labels={"app": "noise"},
+                    ),
+                    spec=PodSpec(node_name="n"),
+                )
+            )
+        bookmark_rv = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            line = resp.readline().strip()
+            if not line:
+                continue  # chunk framing / heartbeats
+            try:
+                d = _json.loads(line)
+            except ValueError:
+                continue  # chunked-encoding size lines
+            assert d.get("type") == "BOOKMARK", (
+                f"selector leaked an event: {d}"
+            )
+            bookmark_rv = int(d["object"]["metadata"]["resourceVersion"])
+            break
+        conn.close()
+        assert bookmark_rv is not None, "no BOOKMARK despite filtered churn"
+        assert bookmark_rv > baseline
+    finally:
+        server.stop()
+
+
+def test_bookmarks_are_opt_in(tier):
+    """Without allowWatchBookmarks a stream never carries BOOKMARKs
+    (existing consumers see only real events and heartbeats)."""
+    store, client = tier.store, tier.client
+    store.create_node(make_node("nb-0"))
+    gen = client.watch_events(
+        ["Pod"], since_rv=store.current_resource_version()
+    )
+    for i in range(6):
+        store.patch_node_labels("nb-0", {"churn": str(i)})
+    deadline = time.monotonic() + 2.0
+    for ev in gen:
+        assert ev is None or ev.type != "BOOKMARK"
+        if time.monotonic() > deadline:
+            break
+    gen.close()
+
+
 # -- controller pump recovery -------------------------------------------------
 
 
@@ -211,7 +371,7 @@ class _ScriptedClient(FakeCluster):
         self.calls: list = []
         self.script_done = threading.Event()
 
-    def watch_events(self, kinds=None, since_rv=None):
+    def watch_events(self, kinds=None, since_rv=None, bookmarks=False):
         call = len(self.calls)
         self.calls.append(since_rv)
         if call == 0:

@@ -301,7 +301,7 @@ def test_watch_pump_reconnects_after_stream_error():
     )
     attempts = {"n": 0}
 
-    def flaky_watch_events(kinds=None, since_rv=None):
+    def flaky_watch_events(kinds=None, since_rv=None, bookmarks=False):
         from k8s_operator_libs_tpu.k8s.client import WatchEvent
 
         attempts["n"] += 1
