@@ -1,0 +1,71 @@
+"""Unit contract of the shared outage-sanitization helper (hostenv).
+
+The integration proof lives in test_outage_guard.py (this interpreter
+and its children really are sanitized); these pin the pure env-dict
+transformations so a refactor can't silently change what 'sanitized'
+means for the three consumers (conftest, dryrun, bench fallback).
+"""
+
+from __future__ import annotations
+
+import os
+
+from k8s_operator_libs_tpu.hostenv import (
+    PLUGIN_GATE_ENV_VAR,
+    pin_current_process_to_cpu,
+    sanitized_cpu_env,
+)
+
+
+def _base() -> dict:
+    return {
+        "PATH": "/usr/bin",
+        PLUGIN_GATE_ENV_VAR: "127.0.0.1",
+        "PYTHONPATH": f"/stuff/lib{os.pathsep}/root/.axon_site",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2 --xla_foo",
+    }
+
+
+def test_strips_gate_var_and_plugin_path_and_pins_cpu():
+    env = sanitized_cpu_env(_base())
+    assert PLUGIN_GATE_ENV_VAR not in env
+    assert env["PYTHONPATH"] == "/stuff/lib"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["PATH"] == "/usr/bin"  # everything else untouched
+
+
+def test_pythonpath_dropped_entirely_when_only_plugin_entries():
+    base = _base()
+    base["PYTHONPATH"] = "/root/.axon_site"
+    env = sanitized_cpu_env(base)
+    assert "PYTHONPATH" not in env
+
+
+def test_host_device_count_replaces_existing_flag():
+    env = sanitized_cpu_env(_base(), host_device_count=8)
+    flags = env["XLA_FLAGS"].split()
+    assert "--xla_force_host_platform_device_count=8" in flags
+    assert "--xla_force_host_platform_device_count=2" not in flags
+    assert "--xla_foo" in flags  # unrelated flags survive
+
+
+def test_prepend_pythonpath_goes_first():
+    env = sanitized_cpu_env(_base(), prepend_pythonpath="/repo")
+    assert env["PYTHONPATH"].split(os.pathsep) == ["/repo", "/stuff/lib"]
+
+
+def test_pin_current_process_is_idempotent_and_reports_success():
+    # conftest already pinned this interpreter; pinning again must be a
+    # safe no-op that still reports the jax internals matched.
+    assert pin_current_process_to_cpu() is True
+    import jax
+
+    assert jax.default_backend() == "cpu"
+
+
+def test_pin_respects_existing_host_device_count():
+    before = os.environ.get("XLA_FLAGS", "")
+    assert "xla_force_host_platform_device_count" in before  # conftest's 8
+    pin_current_process_to_cpu(default_host_device_count=4)
+    assert os.environ["XLA_FLAGS"] == before  # existing count kept
