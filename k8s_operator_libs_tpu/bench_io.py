@@ -1,0 +1,114 @@
+"""Bench artifact emission: one SMALL stdout JSON line + a side file.
+
+The round driver captures only the tail of bench stdout (~4 KB
+observed), so a final metric line that inlines bulky evidence truncates
+its own head away and the headline number never lands (round 4:
+4,148 bytes measured on a complete run -> ``parsed: null``).  The
+contract is therefore split:
+
+- **stdout**: exactly one JSON line, hard-capped at ``MAX_LINE_BYTES``,
+  carrying ``metric/value/unit/vs_baseline`` plus a compact
+  ``details`` summary and the path of the side file;
+- **side file** (``BENCH_DETAILS.json``): the full evidence — per-state
+  transition histories, probe metric dicts, per-roll traces — with no
+  size pressure.
+
+``compact_line`` enforces the cap structurally: if a summary ever grows
+past the budget, expendable keys are dropped (headline keys never are)
+so the driver can always parse the line.  The reference's analogue is
+its CI artifact gate (`.github/workflows/ci.yaml:18-66` upstream): an
+artifact that cannot be consumed by the pipeline is a failure of the
+producer, not the pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping, Optional
+
+# Hard cap for the single stdout line.  The observed driver tail capture
+# is ~4 KB; half that leaves headroom for driver-side framing.
+MAX_LINE_BYTES = 2048
+
+# Keys that must survive any size-pressure dropping: the driver's parse
+# targets plus the honesty labels.
+_PROTECTED = {"complete", "backend", "details_file", "error"}
+
+
+def compact_line(
+    metric: str,
+    value: float,
+    unit: str,
+    vs_baseline: float,
+    summary: Mapping[str, Any],
+) -> str:
+    """Serialize the one-line payload, guaranteed <= MAX_LINE_BYTES.
+
+    Expendable summary keys are dropped last-first under size pressure;
+    the headline fields and ``_PROTECTED`` keys always survive."""
+    details = dict(summary)
+
+    def render() -> str:
+        return json.dumps(
+            {
+                "metric": metric,
+                "value": value,
+                "unit": unit,
+                "vs_baseline": vs_baseline,
+                "details": details,
+            },
+            separators=(",", ":"),
+        )
+
+    line = render()
+    if len(line.encode("utf-8")) <= MAX_LINE_BYTES:
+        return line
+    for key in reversed(list(details)):
+        if key in _PROTECTED:
+            continue
+        del details[key]
+        line = render()
+        if len(line.encode("utf-8")) <= MAX_LINE_BYTES:
+            return line
+    # Only protected keys remain; as a last resort shorten the metric
+    # string, then the longest remaining string values (an oversized
+    # protected 'error'/'backend' must not reintroduce the r4 bug the
+    # cap exists to prevent) — the numbers are never touched.
+    metric = metric[:80]
+    line = render()
+    while len(line.encode("utf-8")) > MAX_LINE_BYTES:
+        key = max(
+            (k for k in details if isinstance(details[k], str)),
+            key=lambda k: len(details[k]),
+            default=None,
+        )
+        if key is None or len(details[key]) <= 8:
+            break
+        details[key] = details[key][: max(8, len(details[key]) // 2)]
+        line = render()
+    return line
+
+
+def emit(
+    metric: str,
+    value: float,
+    unit: str,
+    vs_baseline: float,
+    summary: Mapping[str, Any],
+    full_details: Optional[Mapping[str, Any]] = None,
+    details_path: Optional[str] = None,
+) -> str:
+    """Write the full evidence to ``details_path`` (if given) and print
+    the capped one-line summary to stdout.  Returns the printed line."""
+    summary = dict(summary)
+    if details_path is not None and full_details is not None:
+        tmp = details_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(full_details, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, details_path)
+        summary["details_file"] = os.path.basename(details_path)
+    line = compact_line(metric, value, unit, vs_baseline, summary)
+    print(line, flush=True)
+    return line

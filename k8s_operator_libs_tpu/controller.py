@@ -636,6 +636,11 @@ class UpgradeController:
                     e,
                 )
                 resume_rv = None
+                # Drop the floors too: they hold the rv that just
+                # expired, and the generic reconnect handler below would
+                # otherwise resurrect it after a transient baseline-list
+                # failure, forcing a guaranteed second 410/re-list cycle.
+                floors = {}
                 wake.set()
             except Exception as e:  # noqa: BLE001 — reconnect, don't die
                 logger.warning("watch stream broke (%s); reconnecting", e)

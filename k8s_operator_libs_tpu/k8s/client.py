@@ -121,11 +121,21 @@ class WatchSubscription:
         self._queue: queue.Queue = entry[1]
 
     def get(self, timeout_s: Optional[float] = None) -> Optional[WatchEvent]:
-        """Next event, or None on timeout."""
+        """Next event, or None on timeout.
+
+        The queued event's snapshot is SHARED (with the event log, the
+        cache-lag history, and every other subscriber) — publishing
+        enqueues one object under the cluster lock instead of paying a
+        per-watcher deepcopy while holding it.  The isolating copy
+        happens here, on the consumer's thread: a consumer mutating its
+        event must not corrupt the shared views."""
         try:
-            return self._queue.get(timeout=timeout_s)
+            ev = self._queue.get(timeout=timeout_s)
         except queue.Empty:
             return None
+        if ev.object is None:
+            return ev
+        return WatchEvent(ev.type, ev.kind, copy.deepcopy(ev.object), ev.rv)
 
     def close(self) -> None:
         self._cluster._unwatch(self._entry)
@@ -282,6 +292,15 @@ class FakeCluster:
             return self._rv
 
     def _notify(self, kind: str, event_type: str, snapshot) -> None:
+        # Log-append AND subscriber delivery happen under one lock hold:
+        # the bookmark path reads current_resource_version() and treats
+        # an empty queue as proof that every event <= that snapshot was
+        # delivered.  If the puts happened after releasing the lock, a
+        # writer descheduled between rv-advance and q.put would let a
+        # BOOKMARK advance past an undelivered event, and a client
+        # resuming from that bookmark would skip it.  The puts are cheap
+        # and non-blocking (unbounded queues), so holding the lock
+        # through them is safe.
         with self._lock:
             rv = self._snapshot_rv(snapshot)
             event = WatchEvent(event_type, kind, snapshot, rv)
@@ -289,15 +308,15 @@ class FakeCluster:
             while len(self._event_log) > self._watch_cache_size:
                 evicted_rv, _ = self._event_log.pop(0)
                 self._log_evicted_to = evicted_rv
-            watchers = list(self._watchers)
-        for kinds, q in watchers:
-            if kinds is None or kind in kinds:
-                # Fresh copy per delivery: a consumer mutating its event
-                # must not corrupt the cache-lag history snapshot or
-                # other subscribers' views.
-                q.put(
-                    WatchEvent(event_type, kind, copy.deepcopy(snapshot), rv)
-                )
+            for kinds, q in self._watchers:
+                if kinds is None or kind in kinds:
+                    # The SHARED event object is enqueued — no per-
+                    # watcher deepcopy while holding the cluster-global
+                    # lock (at 256-node scale that would serialize every
+                    # API call behind O(watchers x object-size) copying).
+                    # WatchSubscription.get makes the isolating copy on
+                    # the consumer's thread.
+                    q.put(event)
 
     def _make_notifier(self, kind: str):
         def notify(event_type: str, snapshot) -> None:
@@ -338,14 +357,8 @@ class FakeCluster:
                     if rv > since_rv and (
                         kind_set is None or ev.kind in kind_set
                     ):
-                        q.put(
-                            WatchEvent(
-                                ev.type,
-                                ev.kind,
-                                copy.deepcopy(ev.object),
-                                rv,
-                            )
-                        )
+                        # Shared replay too: get() isolates on consume.
+                        q.put(ev)
             self._watchers.append(entry)
         return WatchSubscription(self, entry)
 
