@@ -49,6 +49,7 @@ to stderr.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -266,15 +267,129 @@ def derive_slice_shape(devices) -> tuple[str, str, int]:
     return accelerator, topology, n
 
 
+def dcn_collective_stage() -> dict:
+    """BASELINE config 5's strongest gate, run for real: one worker
+    PROCESS per DCN ring joins a ``jax.distributed`` (gloo) world and
+    runs ``dcn_collective`` — the world-spanning psum carrying each
+    ring's one-hot contribution (health/probes.py) that fails when the
+    collective transport breaks even while every peer socket still
+    answers.  DCN rides the data-center network, not ICI, so
+    process-separated CPU workers ARE the faithful transport on this
+    single-chip bench host; the per-ring verdicts land in
+    BENCH_DETAILS.json (VERDICT r4 next #6).  Failures are recorded,
+    never raised — a broken collective is a finding, not a bench
+    crash."""
+    import socket as _socket
+
+    from k8s_operator_libs_tpu.k8s import KubeApiServer
+
+    rings = ["ring-a", "ring-b"]
+    t0 = time.monotonic()
+    store = FakeCluster()
+    fx = ClusterFixture(store, UpgradeKeys())
+    for i in range(len(rings)):
+        fx.tpu_node(
+            "bench-dcn", i, accelerator="tpu-multihost-test",
+            topology="2x2", chips_per_host=2,
+        )
+    server = KubeApiServer(store)
+    server.start()
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord_port = s.getsockname()[1]
+    worker = os.path.join(_ROOT, "tests", "multihost_agent_worker.py")
+    verdicts: dict = {}
+    try:
+        # Sanitized cpu env: the workers must never touch (or hang on)
+        # the tunneled accelerator — and must not fight the canary for
+        # the one real chip.
+        base = sanitized_cpu_env()
+        base["K8S_TPU_PROBE_MIN_TIME_S"] = "0.01"
+        procs = []
+        for i, ring in enumerate(rings):
+            env = dict(base)
+            env.update(
+                TPU_WORKER_HOSTNAMES=",".join(["127.0.0.1"] * len(rings)),
+                TPU_WORKER_ID=str(i),
+                JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{coord_port}",
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                TEST_APISERVER_HOST=server.host,
+                NODE_NAME=f"bench-dcn-w{i}",
+                DRIVER_REVISION="v2",
+                HEALTH_DEEP_PROBE="1",
+                HEALTH_DCN_GROUP=ring,
+                HEALTH_DCN_GROUPS=",".join(rings),
+            )
+            procs.append(
+                (
+                    ring,
+                    subprocess.Popen(
+                        [sys.executable, worker],
+                        env=env,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE,
+                        text=True,
+                        cwd=_ROOT,
+                    ),
+                )
+            )
+        for ring, p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate(timeout=10)
+                verdicts[ring] = {"error": "worker timed out"}
+                continue
+            if p.returncode != 0:
+                verdicts[ring] = {
+                    "error": f"worker rc={p.returncode}: {err[-300:]}"
+                }
+                continue
+            # "Never raised" includes a worker that exits 0 with
+            # garbage on stdout — that's a recorded finding too.
+            try:
+                rep = json.loads(out.strip().splitlines()[-1])
+                verdicts[ring] = {
+                    "dcn_collective": rep["checks"].get("dcn_collective"),
+                    "healthy": rep["healthy"],
+                    "failed": rep["failed"],
+                    "process_count": rep.get("process_count"),
+                }
+            except (IndexError, ValueError, KeyError, TypeError) as e:
+                verdicts[ring] = {
+                    "error": f"unparseable worker report ({e!r}): "
+                    f"{out[-200:]!r}"
+                }
+    finally:
+        server.stop()
+    ok = bool(verdicts) and all(
+        v.get("dcn_collective") is True for v in verdicts.values()
+    )
+    return {
+        "ok": ok,
+        "rings": verdicts,
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+
+
+# Failure-injection roll knobs: the gate timeout is short so the FAILED
+# path lands well inside the roll budget, and the stuck threshold sits
+# under it so the wait is evented BEFORE the engine gives up.
+FAILINJ_VALIDATION_TIMEOUT_S = 30
+FAILINJ_STUCK_THRESHOLD_S = 10
+
+
 class RollHarness:
     """One fresh cluster + engine + agent fleet for one rolling upgrade."""
 
     def __init__(
         self, devices, pipeline: bool, dcn: bool = False,
-        small_battery: bool = False,
+        small_battery: bool = False, event_recorder=None,
     ) -> None:
         self.devices = devices
         self.pipeline = pipeline
+        self.event_recorder = event_recorder
         # cpu-fallback mode: dispatch-dominated backend, so the agent
         # batteries shrink to stay honest about wall-clock without
         # changing any gate semantics.
@@ -313,8 +428,8 @@ class RollHarness:
         fx.auto_recreate_driver_pods(ds, "v2")
 
         self.mgr = ClusterUpgradeStateManager(
-            self.cluster, keys=self.keys, poll_interval_s=0.02,
-            poll_timeout_s=5.0,
+            self.cluster, keys=self.keys, event_recorder=event_recorder,
+            poll_interval_s=0.02, poll_timeout_s=5.0,
         )
         # Production wiring: per-host agent reports aggregated per slice,
         # revision-pinned, with the spec-derived HBM floor engaged.
@@ -384,6 +499,10 @@ class RollHarness:
                 )
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Hosts whose probe agent has been "killed" (failure-injection
+        # roll): the agent loop stops running their batteries, modeling a
+        # crashed per-host agent daemon.
+        self.dead_hosts: set[str] = set()
         self.max_concurrent_unavailable = 0
         # Per-DCN-ring concurrency high-water mark (dcn mode): the
         # anti-affinity invariant is that this never exceeds 1.
@@ -425,6 +544,7 @@ class RollHarness:
                 a
                 for a in self.agents
                 if states.get(a.node_name, "") in active
+                and a.node_name not in self.dead_hosts
             ]
             for agent in in_flight:
                 if self._stop.is_set():
@@ -434,7 +554,7 @@ class RollHarness:
                 return
             agent = self.agents[background % len(self.agents)]
             background += 1
-            if agent not in in_flight:
+            if agent not in in_flight and agent.node_name not in self.dead_hosts:
                 agent.run_once()
             time.sleep(0.05)
 
@@ -488,7 +608,11 @@ class RollHarness:
 
     # -- the roll -------------------------------------------------------------
 
-    def run(self) -> dict:
+    def run(self, on_tick=None) -> dict:
+        """One full roll.  ``on_tick(states, t_rel)`` (optional) runs
+        after every reconcile pass with the live node-state map — the
+        failure-injection roll uses it to kill/revive an agent mid-
+        validation and to timestamp the FAILED/recovered transitions."""
         self._threads = [
             threading.Thread(target=self._agent_loop, daemon=True),
             threading.Thread(target=self._sampler_loop, daemon=True),
@@ -534,6 +658,8 @@ class RollHarness:
                         + (f"  [gate: {reject}]" if reject else "")
                     )
                     last_states[sid] = s
+            if on_tick is not None:
+                on_tick(states, time.monotonic() - t0)
             if all(s == "upgrade-done" for s in states.values()):
                 done = True
                 break
@@ -579,6 +705,115 @@ class RollHarness:
 
     def slice_disrupted(self, idx: int) -> bool:
         return self._slice_unavailable(self.slices[idx])
+
+
+def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
+    """Drive the FAILED path end to end on the measured substrate
+    (VERDICT r4 next #7) — the happy path alone proves nothing about
+    failure attribution.  Mid-roll, one host of a designated slice has
+    its probe agent killed and its report withdrawn (a crashed agent
+    daemon): the gate must reject that slice NAMING the missing host,
+    stuck telemetry must event the wait before the engine gives up
+    (threshold 10 s < 30 s gate timeout), the slice must go FAILED
+    within the validation timeout, and after the agent returns the
+    engine's gate-checked recovery must complete the roll.  The full
+    FAILED -> recovered timeline lands in BENCH_DETAILS.json."""
+    from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+
+    recorder = EventRecorder()
+    harness = RollHarness(
+        devices, pipeline=False, small_battery=cpu_fallback,
+        event_recorder=recorder,
+    )
+    harness.policy.health_gate = SliceHealthGateSpec(
+        enable=True, timeout_second=FAILINJ_VALIDATION_TIMEOUT_S
+    )
+    harness.policy.stuck_threshold_second = FAILINJ_STUCK_THRESHOLD_S
+    # Recovery probes are rate-limited after a rejection; a short backoff
+    # keeps the recovered-timeline honest without hammering the battery.
+    harness.mgr.recovery_probe_backoff_s = 5.0
+    harness.sweep_agents_once()
+
+    # Victim: second host of pool-1.  The kill fires the first time
+    # pool-1 leaves the queue (cordon onward) — well before its
+    # validation, so the withdrawn report is visible through the read
+    # cache by the time the gate probes, and the rejection is
+    # deterministic rather than racing the strip against a fast pass.
+    victim = harness.slices[1][1].name
+    active_pre_validation = {
+        "cordon-required", "wait-for-jobs-required",
+        "pod-deletion-required", "drain-required", "pod-restart-required",
+        "validation-required",
+    }
+    timeline: dict = {}
+
+    def on_tick(states, t) -> None:
+        s1 = states.get(harness.slices[1][0].name, "")
+        if "t_agent_killed" not in timeline:
+            if s1 in active_pre_validation:
+                harness.dead_hosts.add(victim)
+                harness.cluster.patch_node_annotations(
+                    victim, {harness.keys.health_report_annotation: None}
+                )
+                timeline["t_agent_killed"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: killed probe agent on "
+                    f"{victim} (pool-1, state {s1})"
+                )
+            return
+        if "t_validation_start" not in timeline:
+            if s1 == "validation-required":
+                timeline["t_validation_start"] = round(t, 2)
+            return
+        if "t_failed" not in timeline:
+            if s1 == "upgrade-failed":
+                timeline["t_failed"] = round(t, 2)
+                # The "operator" heals the agent: it returns, re-probes,
+                # and publishes a fresh report for the recovery gate.
+                harness.dead_hosts.discard(victim)
+                agent = next(
+                    a for a in harness.agents if a.node_name == victim
+                )
+                agent.run_once()
+                timeline["t_agent_returned"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: agent on {victim} "
+                    "returned (fresh report published)"
+                )
+            return
+        if "t_recovered" not in timeline and s1 == "upgrade-done":
+            timeline["t_recovered"] = round(t, 2)
+            log(f"  t={t:7.2f}s fail-inject: pool-1 recovered")
+
+    result = harness.run(on_tick=on_tick)
+    stuck_naming_victim = [
+        e.message
+        for e in recorder.events
+        if "Upgrade stuck" in e.message and victim in e.message
+    ]
+    # "FAILED within the validation timeout" measures from validation
+    # entry (where the gate's clock runs), not from the earlier kill.
+    failed_within = (
+        round(timeline["t_failed"] - timeline["t_validation_start"], 2)
+        if "t_failed" in timeline and "t_validation_start" in timeline
+        else None
+    )
+    return {
+        "complete": result["complete"],
+        "wall_s": result["wall_s"],
+        "victim": victim,
+        "victim_slice": "pool-1",
+        "validation_timeout_s": FAILINJ_VALIDATION_TIMEOUT_S,
+        "stuck_threshold_s": FAILINJ_STUCK_THRESHOLD_S,
+        "timeline": timeline,
+        "failed_within_s": failed_within,
+        "recovered": "t_recovered" in timeline,
+        "stuck_events_naming_victim": len(stuck_naming_victim),
+        "stuck_event_sample": (
+            stuck_naming_victim[0][:300] if stuck_naming_victim else None
+        ),
+        "transitions": result["transitions"],
+    }
 
 
 def main() -> None:
@@ -791,6 +1026,27 @@ def main() -> None:
         f"{dcn_result.get('max_ring_unavailable')})"
     )
 
+    # -- cross-ring XLA collective (the stronger DCN gate, for real) ---------
+    dcn_collective = dcn_collective_stage()
+    log(
+        f"dcn collective (cross-ring psum, one process per ring): "
+        f"ok={dcn_collective['ok']} in {dcn_collective['wall_s']}s "
+        f"rings={ {r: v.get('dcn_collective') for r, v in dcn_collective['rings'].items()} }"
+    )
+
+    # -- roll 4: failure injection (the FAILED path, end to end) -------------
+    log(
+        "failure-injection roll (agent killed mid-roll, gate timeout "
+        f"{FAILINJ_VALIDATION_TIMEOUT_S}s):"
+    )
+    failinj = failure_injection_roll(devices, cpu_fallback)
+    log(
+        f"failure injection: failed_within={failinj['failed_within_s']}s "
+        f"recovered={failinj['recovered']} stuck_events_naming_victim="
+        f"{failinj['stuck_events_naming_victim']} complete="
+        f"{failinj['complete']}"
+    )
+
     # -- device-sustained canary throughput ----------------------------------
     # perf_summary above is wall time (one tunnel round trip per step);
     # this enqueues steps back-to-back so the slope cancels the RTT,
@@ -840,7 +1096,11 @@ def main() -> None:
             "anti_affinity_held": dcn_result.get("max_ring_unavailable", 0)
             <= 1,
             "dp_pair_downtime_s": round(dcn_downtime_s, 3),
+            # Per-ring verdicts from the REAL cross-ring collective (one
+            # jax.distributed process per ring) — VERDICT r4 next #6.
+            "collective": dcn_collective,
         },
+        "failure_injection": failinj,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -891,6 +1151,10 @@ def main() -> None:
         "dcn_wall_s": dcn_result["wall_s"],
         "dcn_anti_affinity_held": details["dcn"]["anti_affinity_held"],
         "dcn_dp_pair_downtime_s": round(dcn_downtime_s, 3),
+        "dcn_collective_ok": dcn_collective["ok"],
+        "failinj_failed_within_s": failinj["failed_within_s"],
+        "failinj_recovered": failinj["recovered"],
+        "failinj_stuck_events": failinj["stuck_events_naming_victim"],
         "mxu_tflops": _num(mxu.get("tflops"), 1),
         "mxu_mfu": _num(mxu.get("mfu"), 3),
         "hbm_gbps": _num(hbm.get("gbps"), 1),
@@ -905,10 +1169,7 @@ def main() -> None:
     }
     watchdog.cancel()
     emit(
-        (
-            "jax workload downtime during slice-atomic libtpu "
-            "rolling upgrade (4x4-host pool, real probe gate)"
-        ),
+        metric_name,
         round(downtime_s, 3),
         "s",
         # An incomplete roll never earns a flattering ratio.

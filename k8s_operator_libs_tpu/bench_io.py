@@ -103,12 +103,19 @@ def emit(
     the capped one-line summary to stdout.  Returns the printed line."""
     summary = dict(summary)
     if details_path is not None and full_details is not None:
-        tmp = details_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(full_details, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, details_path)
-        summary["details_file"] = os.path.basename(details_path)
+        # The side file is optional evidence; the stdout line is the
+        # mandatory artifact.  A full disk or read-only directory must
+        # degrade to a line that SAYS the evidence is missing, never to
+        # a traceback with no line at all.
+        try:
+            tmp = details_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(full_details, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, details_path)
+            summary["details_file"] = os.path.basename(details_path)
+        except (OSError, TypeError, ValueError) as e:
+            summary["details_file"] = f"<write failed: {e}>"[:120]
     line = compact_line(metric, value, unit, vs_baseline, summary)
     print(line, flush=True)
     return line

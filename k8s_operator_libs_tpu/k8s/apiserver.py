@@ -177,14 +177,22 @@ def controller_revision_to_json(rev: ControllerRevision) -> dict:
 def _status_body(
     code: int, reason: str, message: str, causes: Optional[list] = None
 ) -> dict:
+    """metav1.Status, real-apiserver shape: 2xx codes carry
+    ``status: Success`` (and no Failure ``reason``); errors carry
+    ``status: Failure`` + a machine-readable reason.  Clients that
+    switch on ``status``/``reason`` (client-go's error helpers do) would
+    misclassify a body that says Failure on a successful eviction."""
+    success = code < 400
     body = {
         "apiVersion": "v1",
         "kind": "Status",
-        "status": "Failure",
+        "metadata": {},
+        "status": "Success" if success else "Failure",
         "code": code,
-        "reason": reason,
         "message": message,
     }
+    if not success:
+        body["reason"] = reason
     if causes:
         body["details"] = {"causes": causes}
     return body
@@ -195,6 +203,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
     server_version = "tpu-operator-apiserver/1.0"
+    # Small keep-alive responses + Nagle + the client's delayed ACK cost
+    # a flat ~40 ms per exchange; a real apiserver (Go net/http) runs
+    # with TCP_NODELAY for the same reason.
+    disable_nagle_algorithm = True
 
     # Set by KubeApiServer.
     store: FakeCluster = None  # type: ignore[assignment]
@@ -232,7 +244,12 @@ class _Handler(BaseHTTPRequestHandler):
         except NotFoundError as e:
             self._send(404, _status_body(404, "NotFound", str(e)))
         except ConflictError as e:
-            self._send(409, _status_body(409, "AlreadyExists", str(e)))
+            # Real-apiserver reasons differ by verb: a create hitting an
+            # existing name is AlreadyExists; an update losing the
+            # resourceVersion CAS is Conflict ("the object has been
+            # modified").  Both are HTTP 409.
+            reason = "AlreadyExists" if method == "POST" else "Conflict"
+            self._send(409, _status_body(409, reason, str(e)))
         except ExpiredError as e:
             # 410 Gone, reason Expired: a stale watch resourceVersion or
             # list continue token (post-compaction semantics).  Clients

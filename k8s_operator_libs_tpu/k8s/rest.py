@@ -23,6 +23,7 @@ import http.client
 import json
 import os
 import queue
+import socket
 import ssl
 import tempfile
 import threading
@@ -448,17 +449,42 @@ class RestClient:
     def _new_connection(
         self, read_timeout_s: float
     ) -> http.client.HTTPConnection:
-        """A fresh, unpooled connection (watch streams hold one open)."""
+        """A fresh, unpooled connection (watch streams hold one open).
+
+        TCP_NODELAY is set on connect: the request pattern is many small
+        keep-alive messages, where Nagle + the peer's delayed ACK stalls
+        every exchange ~40 ms — measured as a flat ~36 ms per verb on
+        loopback (2.9 s per 64-node snapshot) before this, sub-ms after.
+        Real kube clients (client-go's net.Dialer, urllib3) disable
+        Nagle the same way."""
         if self._https:
-            return http.client.HTTPSConnection(
+            conn: http.client.HTTPConnection = http.client.HTTPSConnection(
                 self._netloc,
                 self._port,
                 timeout=read_timeout_s,
                 context=self._ssl,
             )
-        return http.client.HTTPConnection(
-            self._netloc, self._port, timeout=read_timeout_s
-        )
+        else:
+            conn = http.client.HTTPConnection(
+                self._netloc, self._port, timeout=read_timeout_s
+            )
+        # Wrap (not replace) the lazy connect: connecting eagerly here
+        # would move transient ECONNREFUSED out of _request's retry
+        # block, losing the one-shot reconnect a restarting apiserver
+        # relies on.
+        orig_connect = conn.connect
+
+        def connect_nodelay() -> None:
+            orig_connect()
+            try:
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass  # non-TCP transports (tests may stub the socket)
+
+        conn.connect = connect_nodelay  # type: ignore[method-assign]
+        return conn
 
     def _put_conn(self, conn: http.client.HTTPConnection) -> None:
         with self._pool_lock:

@@ -82,6 +82,14 @@ class StuckStateDetector:
         # group id -> last known progress blocker, supplied by the
         # engine's sub-managers (validation rejection, drain error).
         self._reason_sources: list[Callable[[str], Optional[str]]] = []
+        # FAILED is normally excluded from tracking (see observe), but a
+        # failed group with an OUTSTANDING safety action — e.g. a
+        # rollback eviction blocked by a PDB, workload pods still on
+        # gate-rejected hardware — is not settled: these sources opt
+        # such groups back into dwell tracking, with the source's reason.
+        self._failed_reason_sources: list[
+            Callable[[str], Optional[str]]
+        ] = []
 
     def add_reason_source(
         self, source: Callable[[str], Optional[str]]
@@ -89,6 +97,21 @@ class StuckStateDetector:
         """Register a ``group_id -> reason | None`` lookup (e.g. the
         validation manager's last rejection)."""
         self._reason_sources.append(source)
+
+    def add_failed_reason_source(
+        self, source: Callable[[str], Optional[str]]
+    ) -> None:
+        """Register a lookup that opts FAILED groups into stuck tracking
+        while it returns a reason (an unresolved safety action, e.g. the
+        validation manager's pending rollback evictions)."""
+        self._failed_reason_sources.append(source)
+
+    def _failed_reason(self, group_id: str) -> Optional[str]:
+        for source in self._failed_reason_sources:
+            reason = source(group_id)
+            if reason:
+                return reason
+        return None
 
     def reason_for(self, group_id: str) -> str:
         for source in self._reason_sources:
@@ -105,14 +128,21 @@ class StuckStateDetector:
         now = time.monotonic() if now is None else now
         stuck: list[StuckGroup] = []
         seen: set[str] = set()
-        # FAILED is excluded: a terminally failed group has already had
-        # its own loud failure event, and re-warning "stuck" per host
-        # every minute until manual intervention would flood the event
-        # stream and drown the actionable signal.
+        # FAILED is excluded — UNLESS a failed-reason source reports an
+        # outstanding action for the group: a terminally failed group
+        # has already had its own loud failure event, and re-warning
+        # "stuck" per host every minute until manual intervention would
+        # flood the event stream; but a failed group whose rollback
+        # eviction is still blocked has workload pods running on
+        # hardware the gate rejected, and THAT wait must stay loud and
+        # attributable until it resolves.
         for st in IN_PROGRESS_STATES:
-            if st == UpgradeState.FAILED:
-                continue
             for group in state.groups_in(st):
+                failed_reason = None
+                if st == UpgradeState.FAILED:
+                    failed_reason = self._failed_reason(group.id)
+                    if failed_reason is None:
+                        continue
                 seen.add(group.id)
                 entered = self._entered.get(group.id)
                 if entered is None or entered[0] != st.value:
@@ -122,7 +152,7 @@ class StuckStateDetector:
                     continue
                 dwell = now - entered[1]
                 if self.threshold_s and dwell > self.threshold_s:
-                    reason = self.reason_for(group.id)
+                    reason = failed_reason or self.reason_for(group.id)
                     stuck.append(
                         StuckGroup(group.id, st.value, dwell, reason)
                     )

@@ -143,6 +143,13 @@ class ClusterUpgradeStateManager:
             reasons = getattr(owner, attr, None)  # injected fakes may lack it
             if reasons is not None:
                 self.stuck_detector.add_reason_source(reasons.get)
+        # A FAILED group whose rollback eviction is still blocked (PDB,
+        # API fault) carries an unresolved safety action: opt it into
+        # stuck tracking so the wait stays visible in events + the
+        # slice_stuck_seconds gauge until the eviction lands.
+        pending = getattr(self.validation_manager, "pending_rollback", None)
+        if pending is not None:
+            self.stuck_detector.add_failed_reason_source(pending.get)
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         # Failed-group recovery probes are rate-limited: with a local
@@ -375,6 +382,15 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_failed_groups(current_state, validation_active)
         self.process_validation_required_groups(current_state, validation_active)
         self.process_uncordon_required_groups(current_state)
+        # Re-attempt rollback evictions that previously failed (PDB,
+        # API fault) for groups still FAILED — idempotent, so pods on
+        # gate-rejected hardware are evicted as soon as the blocker
+        # clears rather than lingering until manual intervention.
+        retry = getattr(
+            self.validation_manager, "retry_pending_rollbacks", None
+        )
+        if retry is not None:  # injected fakes may lack it
+            retry(current_state)
         if isinstance(policy, TPUUpgradePolicySpec):
             self.stuck_detector.threshold_s = float(
                 policy.stuck_threshold_second
@@ -770,6 +786,11 @@ class ClusterUpgradeStateManager:
         getattr(self.validation_manager, "last_rejection", {}).pop(
             group.id, None
         )
+        # Recovery re-validated the hardware, so a still-pending rollback
+        # eviction is moot — stop tracking/retrying it.
+        getattr(self.validation_manager, "pending_rollback", {}).pop(
+            group.id, None
+        )
         key = self.keys.initial_state_annotation
         if all(key in m.node.annotations for m in group.members):
             self.provider.change_nodes_upgrade_state(
@@ -986,6 +1007,11 @@ class ClusterUpgradeStateManager:
     # -- test/bench convenience ---------------------------------------------
 
     def wait_for_async_work(self, timeout_s: float = 30.0) -> bool:
-        """Join outstanding drain/eviction workers."""
+        """Join outstanding drain/eviction workers (including the
+        validation manager's rollback-eviction workers)."""
         ok = self.drain_manager.wait_idle(timeout_s)
-        return self.pod_manager.wait_idle(timeout_s) and ok
+        ok = self.pod_manager.wait_idle(timeout_s) and ok
+        wait = getattr(self.validation_manager, "wait_idle", None)
+        if wait is not None:  # injected fakes may lack it
+            ok = wait(timeout_s) and ok
+        return ok

@@ -20,6 +20,7 @@ here the prober interface is first-class and ships with:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Protocol
@@ -34,6 +35,7 @@ from k8s_operator_libs_tpu.upgrade.node_state_provider import (
 from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
 from k8s_operator_libs_tpu.upgrade.util import (
     group_clock_start,
+    EVENT_TYPE_NORMAL,
     EVENT_TYPE_WARNING,
     EventRecorder,
     UpgradeKeys,
@@ -125,6 +127,22 @@ class ValidationManager:
         # not keep running on it.
         self.rollback_drain_timeout_s = 300.0
         self.rollback_poll_interval_s = 1.0
+        # group id -> blocker reason for rollback evictions that FAILED
+        # (PDB, API fault): consumed by the stuck detector (a FAILED
+        # group with workload pods still on gate-rejected hardware is an
+        # outstanding safety action, not a settled terminal state) and by
+        # retry_pending_rollbacks, which re-attempts on later passes.
+        self.pending_rollback: dict[str, str] = {}
+        # Groups with a live rollback worker (never stack two).
+        self._rollback_active: set[str] = set()
+        self._rollback_lock = threading.Lock()
+        # Retry cadence: a FAST-failing blocker (apiserver 500s, auth
+        # fault) would otherwise re-spawn — and re-event per node —
+        # every reconcile pass, flooding the event stream the instant a
+        # watch-driven controller wakes sub-second.  Same rationale as
+        # the engine's recovery_probe_backoff_s.
+        self.rollback_retry_backoff_s = 30.0
+        self._rollback_last_attempt: dict[str, float] = {}
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -186,8 +204,22 @@ class ValidationManager:
             self.provider.change_nodes_upgrade_annotation(group.nodes, key, "null")
 
     def _schedule_rollback_eviction(self, group: UpgradeGroup) -> None:
-        """Evict the workload pods readmitted by the optimistic uncordon."""
+        """Evict the workload pods readmitted by the optimistic uncordon.
+
+        A failure (PDB-blocked eviction, API fault) is NOT log-and-
+        forget: workload pods still running on hardware the gate
+        rejected is an outstanding safety action.  Each failure
+        publishes a Warning event per affected node, records the blocker
+        in ``pending_rollback`` (surfaced through the stuck detector's
+        ``slice_stuck_seconds`` + events), and the engine re-attempts on
+        later passes via :meth:`retry_pending_rollbacks` — the drain is
+        idempotent, so eviction completes once the blocker clears."""
         from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+
+        with self._rollback_lock:
+            if group.id in self._rollback_active:
+                return  # a worker is already evicting this group
+            self._rollback_active.add(group.id)
 
         helper = DrainHelper(
             self.client,
@@ -198,22 +230,89 @@ class ValidationManager:
             poll_interval_s=self.rollback_poll_interval_s,
         )
         node_names = [n.name for n in group.nodes]
+        had_failed_before = group.id in self.pending_rollback
 
         def _rollback() -> None:
-            for name in node_names:
-                try:
-                    helper.run_node_drain(name)
-                except Exception as e:  # noqa: BLE001 — best effort
-                    logger.error(
-                        "rollback eviction of node %s (group %s) failed: "
-                        "%s — workload pods may still be running on "
-                        "unvalidated hardware",
-                        name,
-                        group.id,
-                        e,
+            failures: list[tuple[str, Exception]] = []
+            try:
+                for name in node_names:
+                    try:
+                        helper.run_node_drain(name)
+                    except Exception as e:  # noqa: BLE001 — retried later
+                        failures.append((name, e))
+                        logger.error(
+                            "rollback eviction of node %s (group %s) "
+                            "failed: %s — workload pods may still be "
+                            "running on unvalidated hardware; will retry "
+                            "while the group stays failed",
+                            name,
+                            group.id,
+                            e,
+                        )
+                        log_event(
+                            self.event_recorder,
+                            name,
+                            EVENT_TYPE_WARNING,
+                            self.keys.event_reason,
+                            "Rollback eviction after validation timeout "
+                            f"failed: {e} — workload pods may still be "
+                            "running on unvalidated hardware (will retry)",
+                        )
+                if failures:
+                    self.pending_rollback[group.id] = (
+                        "rollback eviction incomplete on "
+                        f"{len(failures)}/{len(node_names)} node(s) "
+                        f"({', '.join(n for n, _ in failures)}): "
+                        f"{failures[0][1]}"
                     )
+                elif self.pending_rollback.pop(group.id, None) is not None:
+                    # A previously-blocked eviction finally completed:
+                    # close the loop for the operator watching events.
+                    for name in node_names:
+                        log_event(
+                            self.event_recorder,
+                            name,
+                            EVENT_TYPE_NORMAL,
+                            self.keys.event_reason,
+                            "Rollback eviction completed after earlier "
+                            "failures; no workload pods remain on the "
+                            "unvalidated hardware",
+                        )
+            finally:
+                with self._rollback_lock:
+                    self._rollback_active.discard(group.id)
 
+        if had_failed_before:
+            logger.info(
+                "re-attempting blocked rollback eviction for group %s",
+                group.id,
+            )
         self._tracker.spawn(_rollback, name=f"validation-rollback-{group.id}")
+
+    def retry_pending_rollbacks(self, state) -> None:
+        """Re-attempt rollback evictions that previously failed, for
+        groups still in FAILED (the engine calls this every pass).
+        Groups that left FAILED (recovered after the gate passed, or
+        relabeled by an operator) stop being tracked — recovery means
+        the hardware was re-validated, so the eviction is moot."""
+        if not self.pending_rollback:
+            return
+        failed = {g.id: g for g in state.groups_in(UpgradeState.FAILED)}
+        now = time.monotonic()
+        for gid in list(self.pending_rollback):
+            group = failed.get(gid)
+            if group is None:
+                self.pending_rollback.pop(gid, None)
+                self._rollback_last_attempt.pop(gid, None)
+                continue
+            last = self._rollback_last_attempt.get(gid)
+            if (
+                last is not None
+                and now - last < self.rollback_retry_backoff_s
+            ):
+                continue
+            self._rollback_last_attempt[gid] = now
+            self._schedule_rollback_eviction(group)
 
     def wait_idle(self, timeout_s: float = 30.0) -> bool:
         """Join outstanding rollback-eviction workers."""

@@ -48,14 +48,33 @@ def _bench_shaped_summary() -> dict:
         "dcn_collective_ok": True,
         "failinj_failed_within_s": 123.456,
         "failinj_recovered": True,
+        "failinj_stuck_events": 12,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,
         "canary_device_mfu": 0.345,
         "attribution_ok": True,
-        "attempts": [2, 2, 2, 2],
+        "attempts": [2, 2, 2],
         "preflight_attempts": 12,
     }
+
+
+def test_fixture_mirrors_the_real_summary_keys():
+    """The fits-without-dropping pin is only meaningful if this fixture
+    carries every key bench.py actually emits — parse the summary
+    literal out of bench.py and compare."""
+    import os
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "bench.py")) as f:
+        src = f.read()
+    m = re.search(r"\n    summary = \{(.*?)\n    \}\n", src, re.S)
+    assert m, "bench.py summary literal not found"
+    real_keys = set(re.findall(r'"([a-z_0-9]+)":', m.group(1)))
+    fixture_keys = set(_bench_shaped_summary())
+    missing = real_keys - fixture_keys
+    assert not missing, f"fixture missing real summary keys: {missing}"
 
 
 def test_bench_shaped_summary_fits_without_dropping():
