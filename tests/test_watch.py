@@ -160,7 +160,13 @@ def test_watch_unregistered_cr_surfaces_error():
 
 def test_watch_event_snapshots_are_isolated():
     """Mutating a received event object must not corrupt the store's
-    cache history or other subscribers' views."""
+    cache history or other subscribers' views.
+
+    Publishing enqueues ONE shared event object (no per-watcher
+    deepcopy under the cluster lock); the isolating copy happens in
+    WatchSubscription.get on the consumer's thread — so this pins that
+    the isolation really happens for live delivery, replay-from-rv
+    (which shares the event-log entries), and the cache-lag history."""
     cluster = FakeCluster(cache_lag_s=0.0)
     with cluster.watch(["Node"]) as a, cluster.watch(["Node"]) as b:
         cluster.create_node(make_node("n0"))
@@ -168,6 +174,18 @@ def test_watch_event_snapshots_are_isolated():
         ev_a.object.labels["corrupted"] = "yes"
         assert "corrupted" not in b.get(timeout_s=2.0).object.labels
         assert "corrupted" not in cluster.get_node("n0").labels
+    # Replay path: a reconnecting subscriber replays retained log
+    # events — which are the SAME objects the live path delivered, so a
+    # missing get()-side copy would leak one consumer's mutation into
+    # every future replay.
+    with cluster.watch(["Node"], since_rv=0) as c:
+        ev_c = c.get(timeout_s=2.0)
+        assert "corrupted" not in ev_c.object.labels
+        ev_c.object.labels["corrupted-too"] = "yes"
+    with cluster.watch(["Node"], since_rv=0) as d:
+        labels = d.get(timeout_s=2.0).object.labels
+        assert "corrupted" not in labels
+        assert "corrupted-too" not in labels
 
 
 def test_wire_watch_is_scoped_by_namespace_and_selector():
