@@ -138,6 +138,96 @@ PREFLIGHT_RETRY_WAIT_S = float(
 # Wall-clock a complete cpu-fallback bench needs (round-4 outage run
 # completed well inside this); everything above it is retry budget.
 FALLBACK_RESERVE_S = float(os.environ.get("BENCH_FALLBACK_RESERVE_S", "600"))
+# Mid-run stall detection: pre-flight only covers an outage that starts
+# BEFORE the bench; a relay that dies mid-run wedges the next device
+# call forever and would burn the whole watchdog budget producing a
+# value=0 record.  The live paths (roll ticks, probe batteries, canary
+# warmup, worker joins) heartbeat; a daemon monitor watches staleness
+# and — while the cpu-fallback reserve still fits — re-execs onto the
+# sanitized cpu backend so the round still lands a complete, honestly-
+# labeled artifact.  The threshold sits above every legitimate gap
+# (noisy-window battery ~120 s, canary compile ~40 s/step, collective
+# worker join <= 240 s).
+BENCH_STALL_S = float(os.environ.get("BENCH_STALL_S", "420"))
+
+_heartbeat = time.monotonic()
+
+
+def beat() -> None:
+    """Mark liveness (called from every long-running bench path)."""
+    global _heartbeat
+    _heartbeat = time.monotonic()
+
+
+def _stall_action(
+    stale_s: float,
+    remaining_s: float,
+    stall_threshold_s: float = BENCH_STALL_S,
+    reserve_s: float = FALLBACK_RESERVE_S,
+) -> str:
+    """Pure decision: 'ok' (alive), 'reexec' (wedged, fallback fits),
+    or 'fail' (wedged, too late — emit the failure record now instead
+    of silently burning the rest of the budget)."""
+    if stale_s <= stall_threshold_s:
+        return "ok"
+    if remaining_s >= reserve_s:
+        return "reexec"
+    return "fail"
+
+
+def _start_stall_monitor(metric: str, t_start: float) -> threading.Event:
+    """Daemon thread enforcing _stall_action; armed only on the real
+    backend (the sanitized cpu backend has no tunnel to wedge on)."""
+    stop = threading.Event()
+
+    def monitor() -> None:
+        while not stop.wait(10.0):
+            now = time.monotonic()
+            action = _stall_action(
+                now - _heartbeat, BENCH_WATCHDOG_S - (now - t_start)
+            )
+            if action == "ok":
+                continue
+            stale = now - _heartbeat
+            remaining = BENCH_WATCHDOG_S - (now - t_start)
+            if action == "reexec":
+                log(
+                    f"STALL: no heartbeat for {stale:.0f}s (device call "
+                    f"wedged mid-run?); re-exec on sanitized cpu backend "
+                    f"({remaining:.0f}s budget left)"
+                )
+                env = _fallback_env(remaining)
+                env["BENCH_STALL_REEXEC"] = "1"
+                os.execve(
+                    sys.executable,
+                    [sys.executable, os.path.abspath(__file__)]
+                    + sys.argv[1:],
+                    env,
+                )
+            log(
+                f"STALL: no heartbeat for {stale:.0f}s and only "
+                f"{remaining:.0f}s budget left (< {FALLBACK_RESERVE_S:.0f}s "
+                "fallback reserve); emitting failure record now"
+            )
+            emit(
+                metric,
+                0.0,
+                "s",
+                0.0,
+                {
+                    "complete": False,
+                    "watchdog_timeout_s": BENCH_WATCHDOG_S,
+                    "watchdog_stage": "mid-run stall",
+                    "error": "no bench heartbeat for "
+                    f"{stale:.0f}s; a device call most likely wedged "
+                    "(tunnel outage mid-run) too late for cpu fallback",
+                },
+            )
+            os._exit(3)
+
+    t = threading.Thread(target=monitor, daemon=True, name="stall-monitor")
+    t.start()
+    return stop
 
 
 def _fallback_env(remaining_budget_s: float) -> dict:
@@ -334,6 +424,7 @@ def dcn_collective_stage() -> dict:
                 )
             )
         for ring, p in procs:
+            beat()  # subprocess joins are bounded; the bench is alive
             try:
                 out, err = p.communicate(timeout=240)
             except subprocess.TimeoutExpired:
@@ -511,8 +602,12 @@ class RollHarness:
     # -- agent fleet --------------------------------------------------------
 
     def sweep_agents_once(self) -> None:
+        # The heaviest serial probe work in the bench (16 full batteries
+        # on the main thread): beat per agent or the stall monitor sees
+        # a false wedge on a slow tunnel window.
         for agent in self.agents:
             agent.run_once()
+            beat()
 
     def _agent_loop(self) -> None:
         # In production each host's agent probes ITS chips concurrently
@@ -601,9 +696,11 @@ class RollHarness:
         )
         res = self.prober.probe(group)
         ok = (not res.healthy) and victim in res.detail
+        beat()
         # Restore the report so the roll itself is unaffected.
         agent = next(a for a in self.agents if a.node_name == victim)
         agent.run_once()
+        beat()
         return {"ok": ok, "victim": victim, "detail": res.detail}
 
     # -- the roll -------------------------------------------------------------
@@ -636,6 +733,7 @@ class RollHarness:
                 continue
             self.mgr.apply_state(state, self.policy)
             self.mgr.wait_for_async_work(60.0)
+            beat()  # roll tick completed — the bench is alive
             reject = dict(self.mgr.validation_manager.last_rejection)
             if reject != last_reject:
                 for gid, why in reject.items():
@@ -816,11 +914,14 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
     }
 
 
+METRIC_NAME = (
+    "jax workload downtime during slice-atomic libtpu "
+    "rolling upgrade (4x4-host pool, real probe gate)"
+)
+
+
 def main() -> None:
-    metric_name = (
-        "jax workload downtime during slice-atomic libtpu "
-        "rolling upgrade (4x4-host pool, real probe gate)"
-    )
+    metric_name = METRIC_NAME
     # Pre-flight runs under its OWN watchdog, then the measured run gets
     # a fresh full-budget one.  Two-stage because (a) a success that
     # lands late in the retry schedule must still leave the real-backend
@@ -843,6 +944,15 @@ def main() -> None:
     preflight_guard.cancel()
     watchdog = _start_watchdog(metric_name)
     cpu_fallback = os.environ.get("BENCH_FORCED_CPU") == "1"
+    if os.environ.get("BENCH_STALL_REEXEC") == "1":
+        # This process IS the post-stall fallback: record how it got here.
+        preflight["after_mid_run_stall"] = True
+    stall_stop = None
+    if not cpu_fallback:
+        # Mid-run outage net: a wedged device call must cost one stall
+        # threshold, not the whole budget (see BENCH_STALL_S above).
+        beat()
+        stall_stop = _start_stall_monitor(metric_name, time.monotonic())
     devices = jax.devices()
     log(f"bench devices: {[d.device_kind for d in devices]}")
     accelerator, topology, chips_per_host = derive_slice_shape(devices)
@@ -860,13 +970,23 @@ def main() -> None:
 
     def run_battery() -> list:
         # defaults: n=4096, 1 GiB stream.  A transient tunnel error
-        # RAISES (a wedge is the watchdog's job); one retry bridges it.
+        # RAISES (a wedge is the stall monitor's / watchdog's job); one
+        # retry bridges it.  Per-check heartbeats keep the stall monitor
+        # fed through the battery's longest single probes.
+        beat()
         try:
-            return run_host_probe(devices, **battery_kw)
+            out = run_host_probe(
+                devices, on_check=lambda _c: beat(), **battery_kw
+            )
         except Exception as exc:  # noqa: BLE001 — deliberate blip retry
             log(f"probe battery raised ({exc!r}); retrying once in 20s")
+            beat()
             time.sleep(20.0)
-            return run_host_probe(devices, **battery_kw)
+            out = run_host_probe(
+                devices, on_check=lambda _c: beat(), **battery_kw
+            )
+        beat()
+        return out
 
     t_probe = time.monotonic()
     warm = run_battery()
@@ -901,6 +1021,7 @@ def main() -> None:
     canary = CanaryRunner(canary_cfg)
     for _ in range(3):
         canary.run_step()  # compile warmup
+        beat()
 
     def roll_with_canary(
         harness: RollHarness, canary_slices: tuple[int, ...] = (0,)
@@ -934,6 +1055,7 @@ def main() -> None:
         thread.start()
         result = harness.run()
         stop.set()
+        beat()  # the joins below can legitimately block for minutes
         # The runner is SHARED across rolls: a leftover thread would race
         # the next roll's loop on the same donated-buffer jit and append
         # stale timestamps into its reset timing window.  One step can
@@ -1050,8 +1172,12 @@ def main() -> None:
     # -- device-sustained canary throughput ----------------------------------
     # perf_summary above is wall time (one tunnel round trip per step);
     # this enqueues steps back-to-back so the slope cancels the RTT,
-    # giving the MFU an on-host production trainer would see.
+    # giving the MFU an on-host production trainer would see.  One
+    # bounded blocking call (<= 2048 chained steps, ~200 s worst on the
+    # chip) — beat first so the stall monitor clock starts fresh.
+    beat()
     device_perf = canary.sustained_perf_summary()
+    beat()
     log(f"canary device-sustained perf: {device_perf}")
 
     complete = seq_result["complete"]
@@ -1108,12 +1234,20 @@ def main() -> None:
         "device": devices[0].device_kind,
         "n_devices": len(devices),
         # Honest backend attribution: "default" means the real chip;
-        # "cpu-fallback" means the accelerator relay was unreachable at
-        # bench time and the roll ran on the sanitized cpu backend (the
-        # engine/gate/downtime machinery is backend-agnostic; only the
-        # probe TFLOPS/GB/s lose spec-comparability).
+        # "cpu-fallback" means the roll ran on the sanitized cpu backend
+        # with the CAUSE named — unreachable at pre-flight vs wedged
+        # mid-run (stall re-exec) — because this field is the artifact's
+        # account of when the outage happened (the engine/gate/downtime
+        # machinery is backend-agnostic; only the probe TFLOPS/GB/s lose
+        # spec-comparability).
         "backend": (
-            "cpu-fallback (accelerator relay unreachable at pre-flight)"
+            (
+                "cpu-fallback (accelerator relay wedged mid-run; "
+                "stall re-exec)"
+                if os.environ.get("BENCH_STALL_REEXEC") == "1"
+                else "cpu-fallback (accelerator relay unreachable at "
+                "pre-flight)"
+            )
             if cpu_fallback
             else "default"
         ),
@@ -1168,6 +1302,10 @@ def main() -> None:
         "preflight_attempts": preflight.get("attempts"),
     }
     watchdog.cancel()
+    if stall_stop is not None:
+        # Measurement is over; the monitor must not fire while the
+        # details file and final line are being written.
+        stall_stop.set()
     emit(
         metric_name,
         round(downtime_s, 3),
@@ -1185,4 +1323,27 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the artifact must land
+        # Last line of the artifact contract: an unhandled exception
+        # anywhere in the bench (a crashed harness thread check, a
+        # device fault that raised instead of wedging) must still leave
+        # the driver ONE parseable line — an honest failure record beats
+        # a traceback with no artifact.
+        import traceback
+
+        log(traceback.format_exc())
+        emit(
+            METRIC_NAME,
+            0.0,
+            "s",
+            0.0,
+            {
+                "complete": False,
+                "error": f"unhandled {type(e).__name__}: {e}"[:300],
+            },
+        )
+        raise SystemExit(4)

@@ -927,6 +927,7 @@ def run_host_probe(
     dcn_peers: Optional[Sequence[str]] = None,
     dcn_group: str = "",
     dcn_expected_groups: Optional[Sequence[str]] = None,
+    on_check=None,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
 
@@ -935,21 +936,34 @@ def run_host_probe(
     reported TFLOPS/GB/s figures are comparable to chip spec and usable
     as health floors; tests/CI pass small overrides.
 
+    ``on_check`` (optional ``CheckResult -> None``) is invoked as each
+    check completes — a progress/liveness hook for callers running the
+    battery under a stall watchdog (the bench) or emitting per-check
+    telemetry.
+
     Fail-fast on enumeration (nothing else can run without devices), then
     run every remaining probe even if one fails — the per-check results
     are what make a slice-health verdict attributable."""
+    results: list[CheckResult] = []
+
+    def add(check: CheckResult) -> None:
+        results.append(check)
+        if on_check is not None:
+            on_check(check)
+
     try:
         devs = list(devices) if devices is not None else list(jax.devices())
     except RuntimeError as e:  # no backend at all — driver not loaded
-        return [
+        add(
             CheckResult(
                 "device_enumeration",
                 False,
                 0.0,
                 f"device enumeration failed: {e}",
             )
-        ]
-    results = [device_inventory(devs, expected_devices)]
+        )
+        return results
+    add(device_inventory(devs, expected_devices))
     if not devs:
         return results
     # Single-device probes must run on a device THIS process addresses:
@@ -960,18 +974,18 @@ def run_host_probe(
     # (single-process) view.
     local = [d for d in devs if d.process_index == d.client.process_index()]
     probe_dev = local[0] if local else devs[0]
-    results.append(
+    add(
         matmul_probe(
             probe_dev, n=matmul_n, min_time_s=min_time_s, max_iters=max_iters
         )
     )
-    results.append(
+    add(
         hbm_bandwidth_probe(
             probe_dev, mib=hbm_mib, min_time_s=min_time_s, max_iters=max_iters
         )
     )
     if not skip_ici:
-        results.append(
+        add(
             ici_allreduce_probe(
                 devs,
                 per_device_elems=allreduce_elems,
@@ -979,17 +993,17 @@ def run_host_probe(
                 max_iters=max_iters,
             )
         )
-        results.append(ici_ring_probe(devs))
+        add(ici_ring_probe(devs))
         if deep:
-            results.append(ici_ring_attention_probe(devs))
+            add(ici_ring_attention_probe(devs))
     if dcn_peers:
-        results.append(dcn_reachability_probe(dcn_peers))
+        add(dcn_reachability_probe(dcn_peers))
     if dcn_expected_groups:
         # The collective gate (north star: "XLA all-reduce reachability")
         # — runs over the full jax.distributed world and proves every
         # peer DCN group's contribution lands; reachability above stays
         # as the cheap attribution aid when both are configured.
-        results.append(
+        add(
             dcn_collective_probe(
                 devs, dcn_group=dcn_group,
                 expected_groups=dcn_expected_groups,

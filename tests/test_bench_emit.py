@@ -173,6 +173,41 @@ def test_emit_splits_bulk_to_side_file(tmp_path, capsys):
     assert side["backend"].startswith("default")
 
 
+def test_stall_monitor_decision_table():
+    """Mid-run stall policy: alive under the threshold; wedged with the
+    cpu-fallback reserve still fitting -> re-exec; wedged too late ->
+    emit the failure record immediately (never silently burn the rest
+    of the budget)."""
+    import bench
+
+    assert bench._stall_action(10, 1000, 420, 600) == "ok"
+    assert bench._stall_action(420, 1000, 420, 600) == "ok"  # boundary
+    assert bench._stall_action(421, 800, 420, 600) == "reexec"
+    assert bench._stall_action(500, 600, 420, 600) == "reexec"  # just fits
+    assert bench._stall_action(421, 599, 420, 600) == "fail"
+    assert bench._stall_action(10_000, 0, 420, 600) == "fail"
+
+
+def test_probe_battery_reports_per_check_progress():
+    """The bench runs the battery under the stall monitor via the
+    on_check hook — every completed check must tick it, in order."""
+    from k8s_operator_libs_tpu.health.probes import run_host_probe
+
+    import jax
+
+    seen = []
+    results = run_host_probe(
+        jax.devices("cpu")[:1],
+        matmul_n=32,
+        hbm_mib=1,
+        allreduce_elems=64,
+        skip_ici=True,
+        on_check=seen.append,
+    )
+    assert [c.name for c in seen] == [c.name for c in results]
+    assert len(seen) >= 3  # enumeration + matmul + hbm
+
+
 def test_bench_py_promises_the_capped_contract():
     """bench.py must route its final line through bench_io.emit — a
     future direct print(json.dumps(...)) reintroduces the r4 bug."""
@@ -184,3 +219,6 @@ def test_bench_py_promises_the_capped_contract():
     assert "from k8s_operator_libs_tpu.bench_io import emit" in src
     assert "json.dumps" not in src
     assert "BENCH_DETAILS.json" in src
+    # The crash guard: an unhandled exception must still emit ONE line.
+    assert "except BaseException" in src
+    assert "raise SystemExit(4)" in src
