@@ -72,8 +72,12 @@ def _build_scenario(seed: int):
     fx = ClusterFixture(cluster, keys)
     ds = fx.daemon_set(hash_suffix="v1", revision=1)
     slices = {}
+    ring_of: dict = {}
     for i in range(n_slices):
-        kw = {"dcn_group": f"ring-{i // 2}"} if dcn else {}
+        kw = {}
+        if dcn:
+            ring_of[f"pool-{i}"] = f"ring-{i // 2}"
+            kw["dcn_group"] = ring_of[f"pool-{i}"]
         slices[f"pool-{i}"] = fx.tpu_slice(f"pool-{i}", hosts=hosts, **kw)
     for nodes in slices.values():
         for n in nodes:
@@ -125,7 +129,8 @@ def _build_scenario(seed: int):
     mgr.validation_manager.rollback_drain_timeout_s = 0.2
     mgr.validation_manager.rollback_poll_interval_s = 0.02
     mgr.validation_manager.rollback_retry_backoff_s = 0.0
-    return cluster, keys, mgr, recorder, slices, policy, fault, budget, dcn
+    return (cluster, keys, mgr, recorder, slices, policy, fault,
+            budget, dcn, ring_of)
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -140,6 +145,7 @@ def test_random_scenarios_hold_invariants(seed):
         fault,
         budget,
         dcn,
+        ring_of,
     ) = _build_scenario(seed)
 
     def unavailable_slices():
@@ -174,7 +180,7 @@ def test_random_scenarios_hold_invariants(seed):
         if dcn:
             rings: dict[str, int] = {}
             for name in down:
-                ring = f"ring-{int(name.split('-')[1]) // 2}"
+                ring = ring_of[name]
                 rings[ring] = rings.get(ring, 0) + 1
             worst = max(rings.values(), default=0)
             max_ring_seen = max(max_ring_seen, worst)

@@ -267,6 +267,17 @@ def test_requests_and_responses_match_goldens(wire):
     )
     assert_exchange("list_pods_on_node_field_selector", ex[0])
 
+    # Pod GET and the chunked pod pager.
+    ex = drive(exchanges, lambda: client.get_pod("default", "wl-2"))
+    assert_exchange("get_pod", ex[0])
+    ex = drive(
+        exchanges,
+        lambda: client.list_page(
+            "Pod", namespace="default", label_selector="app=wl", limit=2
+        ),
+    )
+    assert_exchange("list_pods_chunked", ex[0])
+
     # DELETE + policy/v1 Eviction (success 201, PDB-blocked 429).
     ex = drive(exchanges, lambda: client.delete_pod("default", "wl-1"))
     assert_exchange("delete_pod", ex[0])
@@ -284,6 +295,24 @@ def test_requests_and_responses_match_goldens(wire):
     assert_exchange("create_daemon_set", ex[0])
     ex = drive(exchanges, lambda: client.update_daemon_set(ds))
     assert_exchange("update_daemon_set", ex[0])
+    ex = drive(
+        exchanges, lambda: client.get_daemon_set("driver-ns", "golden-ds")
+    )
+    assert_exchange("get_daemon_set", ex[0])
+    ex = drive(
+        exchanges,
+        lambda: client.list_daemon_sets(
+            "driver-ns", match_labels={"app": "libtpu-driver"}
+        ),
+    )
+    assert_exchange("list_daemon_sets_by_selector", ex[0])
+    ex = drive(
+        exchanges,
+        lambda: client.list_controller_revisions(
+            "driver-ns", label_selector="app=libtpu-driver"
+        ),
+    )
+    assert_exchange("list_controller_revisions", ex[0])
 
     # Events: client-supplied name, involvedObject, field-selector list.
     ex = drive(
@@ -359,6 +388,34 @@ def test_requests_and_responses_match_goldens(wire):
             },
         )
     assert_exchange("create_policy_cr_invalid_422", exchanges[-1])
+
+    # CR happy path: /status subresource PUT, namespaced list, delete.
+    gvp = (
+        "upgrade.tpu.google.com", "v1alpha1", "tpuupgradepolicies",
+        "default",
+    )
+    client.create_custom_object(
+        *gvp,
+        {
+            "apiVersion": "upgrade.tpu.google.com/v1alpha1",
+            "kind": "TPUUpgradePolicy",
+            "metadata": {"name": "golden-ok"},
+            "spec": {"autoUpgrade": True},
+        },
+    )
+    cr = client.get_custom_object(*gvp, "golden-ok")
+    cr["status"] = {"upgradesDone": 1}
+    ex = drive(
+        exchanges,
+        lambda: client.update_custom_object_status(*gvp, cr),
+    )
+    assert_exchange("update_policy_cr_status_subresource", ex[0])
+    ex = drive(exchanges, lambda: client.list_custom_objects(*gvp))
+    assert_exchange("list_custom_objects", ex[0])
+    ex = drive(
+        exchanges, lambda: client.delete_custom_object(*gvp, "golden-ok")
+    )
+    assert_exchange("delete_custom_object", ex[0])
 
 
 # -- watch framing ------------------------------------------------------------
