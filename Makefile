@@ -50,16 +50,25 @@ lint:
 	fi
 
 # Static check of the typed client boundary (KubeClient Protocol,
-# k8s/interface.py).  mypy is not baked into every dev image; the
-# runtime conformance tests (tests/test_client_interface.py) are the
-# always-on gate, this is the CI-side static one.
+# k8s/interface.py) plus the fault-tolerance layer.  mypy is not baked
+# into every dev image, so the target degrades to a loud skip when it is
+# absent (the devel image and CI both have it — a real mypy failure
+# still fails the build there); the runtime conformance tests
+# (tests/test_client_interface.py) are the always-on gate.
 typecheck:
-	$(PYTHON) -m mypy --ignore-missing-imports \
-		--follow-imports=silent \
-		k8s_operator_libs_tpu/k8s/interface.py \
-		k8s_operator_libs_tpu/k8s/client.py \
-		k8s_operator_libs_tpu/k8s/rest.py \
-		k8s_operator_libs_tpu/upgrade/
+	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
+		$(PYTHON) -m mypy --ignore-missing-imports \
+			--follow-imports=silent \
+			k8s_operator_libs_tpu/k8s/interface.py \
+			k8s_operator_libs_tpu/k8s/client.py \
+			k8s_operator_libs_tpu/k8s/faults.py \
+			k8s_operator_libs_tpu/k8s/retry.py \
+			k8s_operator_libs_tpu/k8s/rest.py \
+			k8s_operator_libs_tpu/upgrade/; \
+	else \
+		echo "typecheck: mypy not installed; skipping" \
+			"(pip install mypy, or run 'make docker-typecheck')"; \
+	fi
 
 # Line coverage via the in-repo sys.monitoring runner; fails the build
 # under the threshold (reference parity: ci.yaml:50-66 coverage gate).

@@ -74,8 +74,14 @@ def compact_line(
     # Only protected keys remain; as a last resort shorten the metric
     # string, then the longest remaining string values (an oversized
     # protected 'error'/'backend' must not reintroduce the r4 bug the
-    # cap exists to prevent) — the numbers are never touched.
+    # cap exists to prevent) — the numbers are never touched.  Protected
+    # values that are not strings (a list of tracebacks smuggled under
+    # 'error') are flattened to truncated strings first so the shrink
+    # loop can always make progress.
     metric = metric[:80]
+    for key, val in list(details.items()):
+        if not isinstance(val, (str, int, float, bool, type(None))):
+            details[key] = json.dumps(val, default=str)[:200]
     line = render()
     while len(line.encode("utf-8")) > MAX_LINE_BYTES:
         key = max(
@@ -86,6 +92,14 @@ def compact_line(
         if key is None or len(details[key]) <= 8:
             break
         details[key] = details[key][: max(8, len(details[key]) // 2)]
+        line = render()
+    if len(line.encode("utf-8")) > MAX_LINE_BYTES:
+        # Unconditional floor: the driver must always get a parseable
+        # line.  Drop the details payload entirely rather than emit an
+        # over-budget line that truncates its own head away.
+        details.clear()
+        details["dropped"] = "details exceeded line budget"
+        unit = unit[:32]
         line = render()
     return line
 

@@ -43,6 +43,7 @@ from k8s_operator_libs_tpu.driver.daemonset import (
 )
 from k8s_operator_libs_tpu.health import NodeReportProber
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
+from k8s_operator_libs_tpu.k8s.retry import CircuitOpenError
 from k8s_operator_libs_tpu.metrics import (
     MetricsRegistry,
     MetricsServer,
@@ -185,36 +186,44 @@ class UpgradeController:
 
     def reconcile_once(self) -> bool:
         """One full pass; returns False when the snapshot was incoherent
-        (requeue and retry, reference reconcile-error semantics)."""
+        (requeue and retry, reference reconcile-error semantics) or when
+        the client's circuit breaker fast-failed the pass (degraded mode:
+        the condition/metrics surface it, the loop keeps ticking, and the
+        breaker's half-open probes heal the path)."""
         t0 = time.monotonic()
-        if self.config.policy_ref is not None:
-            self._refresh_policy_from_cr()
-        if not self._still_leading():
-            return False
-        if self.ds_reconciler is not None:
-            self.ds_reconciler.reconcile()
-        if self.agent_reconciler is not None:
-            self.config.agent_spec.driver_revision = (
-                self._current_driver_revision()
-            )
-            self.agent_reconciler.reconcile()
         try:
-            state = self.manager.build_state(
-                self.config.namespace,
-                self.config.driver_labels,
-                self.config.policy,
-            )
-        except BuildStateError as e:
-            logger.warning("build_state: %s (requeueing)", e)
+            if self.config.policy_ref is not None:
+                self._refresh_policy_from_cr()
+            if not self._still_leading():
+                return False
+            if self.ds_reconciler is not None:
+                self.ds_reconciler.reconcile()
+            if self.agent_reconciler is not None:
+                self.config.agent_spec.driver_revision = (
+                    self._current_driver_revision()
+                )
+                self.agent_reconciler.reconcile()
+            try:
+                state = self.manager.build_state(
+                    self.config.namespace,
+                    self.config.driver_labels,
+                    self.config.policy,
+                )
+            except BuildStateError as e:
+                logger.warning("build_state: %s (requeueing)", e)
+                return False
+            # Re-check right before the mutating phase: a pass that
+            # outlived the renew deadline (apiserver latency, huge
+            # snapshot) must not cordon/drain concurrently with a
+            # successor that has already taken over.  is_leader() goes
+            # False at the renew deadline, BEFORE anyone else's observed
+            # term expires.
+            if not self._still_leading():
+                return False
+            self.manager.apply_state(state, self.config.policy)
+        except CircuitOpenError as e:
+            self._handle_circuit_open(e)
             return False
-        # Re-check right before the mutating phase: a pass that outlived
-        # the renew deadline (apiserver latency, huge snapshot) must not
-        # cordon/drain concurrently with a successor that has already
-        # taken over.  is_leader() goes False at the renew deadline,
-        # BEFORE anyone else's observed term expires.
-        if not self._still_leading():
-            return False
-        self.manager.apply_state(state, self.config.policy)
         if self.config.policy_ref is not None:
             self._update_cr_status(state)
         duration = time.monotonic() - t0
@@ -222,6 +231,56 @@ class UpgradeController:
         self.slice_timer.observe_state(state)
         self._flush_events(state)
         return True
+
+    def _open_circuit_count(self) -> int:
+        breaker = getattr(self.client, "breaker", None)
+        if breaker is None or not hasattr(breaker, "open_endpoints"):
+            return 0
+        return len(breaker.open_endpoints())
+
+    def _handle_circuit_open(self, exc: CircuitOpenError) -> None:
+        """Degrade gracefully instead of crashing or wedging: log once
+        per pass, publish the gauge, and best-effort surface a Degraded
+        condition on the policy CR (an outage scoped to some endpoints
+        still lets the status write land; a total one is swallowed and
+        retried next pass)."""
+        logger.warning(
+            "reconcile degraded: %s (ticking on; half-open probes will "
+            "close the circuit once the apiserver recovers)",
+            exc,
+        )
+        self.metrics.registry.set(
+            "api_circuit_open_endpoints",
+            float(max(1, self._open_circuit_count())),
+        )
+        self._flush_events()
+        if self.config.policy_ref is None or self._policy_cr is None:
+            return
+        from k8s_operator_libs_tpu.api.schema import (
+            POLICY_GROUP,
+            POLICY_PLURAL,
+            POLICY_VERSION,
+        )
+
+        ns, name = self.config.policy_ref
+        cr = self._policy_cr
+        prev_status = dict(cr.get("status") or {})
+        status = dict(prev_status)
+        status["apiCircuitOpenEndpoints"] = max(
+            1, self._open_circuit_count()
+        )
+        status["conditions"] = self._conditions(
+            status, prev_status.get("conditions") or []
+        )
+        if status == prev_status:
+            return
+        cr["status"] = status
+        try:
+            self.client.update_custom_object_status(
+                POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort while degraded
+            logger.debug("degraded status publication failed: %s", e)
 
     def _flush_events(self, state=None) -> None:
         """Drain recorded events to the log AND, when enabled, to the
@@ -363,6 +422,7 @@ class UpgradeController:
                 "currentUnavailableNodes": m.get_current_unavailable_nodes(
                     state
                 ),
+                "apiCircuitOpenEndpoints": self._open_circuit_count(),
             }
             status["conditions"] = self._conditions(
                 status, (cr.get("status") or {}).get("conditions") or []
@@ -380,33 +440,60 @@ class UpgradeController:
     def _conditions(status: dict, previous: list[dict]) -> list[dict]:
         """Standard operator status.conditions derived from the counters,
         with lastTransitionTime preserved while a condition's status is
-        unchanged (k8s meta.v1 Condition semantics)."""
-        in_flight = status["upgradesInProgress"] + status["upgradesPending"]
+        unchanged (k8s meta.v1 Condition semantics).
+
+        Degraded is True on failed slices OR an open API circuit (the
+        controller cannot currently drive the cluster); counters are read
+        with defaults so a degraded pass can rebuild conditions from a
+        partial previous status."""
+        in_progress = status.get("upgradesInProgress", 0)
+        pending = status.get("upgradesPending", 0)
+        failed = status.get("upgradesFailed", 0)
+        open_circuits = status.get("apiCircuitOpenEndpoints", 0)
+        in_flight = in_progress + pending
+        if failed:
+            degraded_reason = "SlicesFailed"
+            degraded_msg = f"{failed} node(s) in upgrade-failed"
+            if open_circuits:
+                degraded_msg += (
+                    f"; {open_circuits} API endpoint(s) circuit-open"
+                )
+        elif open_circuits:
+            degraded_reason = "ApiCircuitOpen"
+            degraded_msg = (
+                f"{open_circuits} API endpoint(s) circuit-open after "
+                "sustained apiserver failures; reconcile is degraded "
+                "until the circuit closes"
+            )
+        else:
+            degraded_reason = "AllHealthy"
+            degraded_msg = f"{failed} node(s) in upgrade-failed"
         want = [
             (
                 "Progressing",
                 in_flight > 0,
                 "UpgradesInFlight" if in_flight else "NoPendingUpgrades",
-                f"{status['upgradesInProgress']} in progress, "
-                f"{status['upgradesPending']} pending",
+                f"{in_progress} in progress, "
+                f"{pending} pending",
             ),
             (
                 "Degraded",
-                status["upgradesFailed"] > 0,
-                "SlicesFailed" if status["upgradesFailed"] else "AllHealthy",
-                f"{status['upgradesFailed']} node(s) in upgrade-failed",
+                failed > 0 or open_circuits > 0,
+                degraded_reason,
+                degraded_msg,
             ),
             (
                 "Complete",
-                in_flight == 0 and status["upgradesFailed"] == 0,
+                in_flight == 0 and failed == 0,
                 (
                     "AllDone"
-                    if in_flight == 0 and status["upgradesFailed"] == 0
+                    if in_flight == 0 and failed == 0
                     else "Failures"
-                    if status["upgradesFailed"]
+                    if failed
                     else "InProgress"
                 ),
-                f"{status['upgradesDone']}/{status['totalManagedNodes']} "
+                f"{status.get('upgradesDone', 0)}/"
+                f"{status.get('totalManagedNodes', 0)} "
                 "nodes at the current driver",
             ),
         ]

@@ -28,11 +28,27 @@ from k8s_operator_libs_tpu.k8s.objects import (  # noqa: F401
     PodPhase,
 )
 from k8s_operator_libs_tpu.k8s.client import (  # noqa: F401
+    ConflictError,
+    EvictionBlockedError,
     ExpiredError,
     FakeCluster,
     InvalidError,
     NotFoundError,
+    ServerError,
+    ThrottledError,
     WatchEvent,
+)
+from k8s_operator_libs_tpu.k8s.faults import (  # noqa: F401
+    Fault,
+    FaultRule,
+    FaultSchedule,
+)
+from k8s_operator_libs_tpu.k8s.retry import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+    ResilientClient,
+    RetryPolicy,
+    is_transient,
 )
 from k8s_operator_libs_tpu.k8s.drain import DrainHelper, DrainError  # noqa: F401
 from k8s_operator_libs_tpu.k8s.interface import KubeClient  # noqa: F401

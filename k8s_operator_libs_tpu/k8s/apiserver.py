@@ -24,7 +24,10 @@ from __future__ import annotations
 
 import datetime
 import json
+import socket
+import struct
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -37,7 +40,10 @@ from k8s_operator_libs_tpu.k8s.client import (
     FakeCluster,
     InvalidError,
     NotFoundError,
+    ServerError,
+    ThrottledError,
 )
+from k8s_operator_libs_tpu.k8s.faults import Fault, FaultSchedule
 from k8s_operator_libs_tpu.k8s.objects import (
     ControllerRevision,
     DaemonSet,
@@ -211,17 +217,24 @@ class _Handler(BaseHTTPRequestHandler):
     # Set by KubeApiServer.
     store: FakeCluster = None  # type: ignore[assignment]
     stopping: threading.Event = None  # type: ignore[assignment]
+    # Optional FaultSchedule: consulted per request (and per watch-stream
+    # iteration) to synthesize the wire shape of injected faults.
+    faults: Optional[FaultSchedule] = None
 
     def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib logging
         logger.debug("apiserver: " + fmt, *args)
 
     # -- plumbing -----------------------------------------------------------
 
-    def _send(self, code: int, body: dict) -> None:
+    def _send(
+        self, code: int, body: dict, headers: Optional[dict] = None
+    ) -> None:
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(data)
 
@@ -239,6 +252,14 @@ class _Handler(BaseHTTPRequestHandler):
         # in the socket and desync the next keep-alive request.
         length = int(self.headers.get("Content-Length", 0) or 0)
         self._raw_body = self.rfile.read(length) if length else b""
+        if self.faults is not None and query.get("watch") != "true":
+            # Unary fault check.  Watch requests are excluded here —
+            # streams are dropped mid-flight by _stream_watch via
+            # decide_watch_drop, not failed at establishment (a rule
+            # matching "watch" would otherwise starve reconnects).
+            fault = self.faults.decide(f"{method} {url.path}")
+            if fault is not None and self._apply_fault(fault):
+                return
         try:
             self._dispatch(method, parts, query)
         except NotFoundError as e:
@@ -279,11 +300,88 @@ class _Handler(BaseHTTPRequestHandler):
                     causes=[{"reason": "DisruptionBudget", "message": str(e)}],
                 ),
             )
+        except ThrottledError as e:
+            # Priority-and-fairness 429 (non-eviction): plain
+            # TooManyRequests Status + Retry-After, no DisruptionBudget
+            # cause — the client classifies on exactly that difference.
+            self._send(
+                429,
+                _status_body(429, "TooManyRequests", str(e)),
+                headers={"Retry-After": str(e.retry_after_s)},
+            )
+        except ServerError as e:
+            self._send(
+                e.status,
+                _status_body(
+                    e.status,
+                    "ServiceUnavailable"
+                    if e.status == 503
+                    else "InternalError",
+                    str(e),
+                ),
+            )
         except Exception as e:  # noqa: BLE001 — surface as 500, don't die
             logger.exception("apiserver handler error")
             self._send(
                 500, _status_body(500, "InternalError", f"{type(e).__name__}: {e}")
             )
+
+    def _apply_fault(self, fault: Fault) -> bool:
+        """Synthesize the wire shape of an injected fault.  Returns True
+        when the request was fully handled (response sent or connection
+        doomed); False lets normal dispatch proceed."""
+        if fault.kind == "throttle":
+            self._send(
+                429,
+                _status_body(429, "TooManyRequests", fault.message),
+                headers={"Retry-After": str(fault.retry_after_s)},
+            )
+            return True
+        if fault.kind == "error":
+            self._send(
+                fault.status,
+                _status_body(
+                    fault.status,
+                    "ServiceUnavailable"
+                    if fault.status == 503
+                    else "InternalError",
+                    fault.message,
+                ),
+            )
+            return True
+        if fault.kind == "conflict":
+            self._send(
+                409, _status_body(409, "Conflict", fault.message)
+            )
+            return True
+        if fault.kind in ("reset", "timeout"):
+            if fault.kind == "timeout":
+                # Stall past the client's timeout, in slices so server
+                # shutdown isn't held hostage by an injected delay.
+                deadline = time.monotonic() + fault.delay_s
+                while (
+                    time.monotonic() < deadline
+                    and not self.stopping.is_set()
+                ):
+                    time.sleep(
+                        min(0.05, max(0.0, deadline - time.monotonic()))
+                    )
+            # SO_LINGER(on, 0): the server's connection close becomes a
+            # TCP RST — the client sees ConnectionResetError with no
+            # HTTP response, the connection-level transient it must
+            # classify and retry.  No response is written; the normal
+            # close path (close_connection) delivers the reset.
+            try:
+                self.connection.setsockopt(
+                    socket.SOL_SOCKET,
+                    socket.SO_LINGER,
+                    struct.pack("ii", 1, 0),
+                )
+            except OSError:
+                pass
+            self.close_connection = True
+            return True
+        return False  # watch_drop (or unknown): not a unary fault
 
     # -- dispatch -----------------------------------------------------------
 
@@ -490,6 +588,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             while not self.stopping.is_set():
+                if self.faults is not None and (
+                    self.faults.decide_watch_drop(
+                        "watch " + ",".join(kinds).lower()
+                    )
+                    is not None
+                ):
+                    # Injected drop: terminate the chunked body cleanly
+                    # (below) so the client sees the stream close and
+                    # runs its reconnect contract, exactly like a real
+                    # apiserver timing out a watch.
+                    break
                 # Snapshot BEFORE the timed get (an empty queue over the
                 # window proves every event <= snapshot was delivered, so
                 # the snapshot is a safe BOOKMARK resume point).  Skipped
@@ -791,16 +900,37 @@ class KubeApiServer:
     (rest.RestClient.get_node notes the same).
     """
 
-    def __init__(self, store: Optional[FakeCluster] = None, port: int = 0):
+    def __init__(
+        self,
+        store: Optional[FakeCluster] = None,
+        port: int = 0,
+        fault_schedule: Optional[FaultSchedule] = None,
+    ):
         self.store = store if store is not None else FakeCluster()
         self._stopping = threading.Event()
-        handler = type(
+        self._handler_cls = type(
             "BoundHandler",
             (_Handler,),
-            {"store": self.store, "stopping": self._stopping},
+            {
+                "store": self.store,
+                "stopping": self._stopping,
+                "faults": fault_schedule,
+            },
         )
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", port), self._handler_cls
+        )
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def fault_schedule(self) -> Optional[FaultSchedule]:
+        return self._handler_cls.faults
+
+    @fault_schedule.setter
+    def fault_schedule(self, schedule: Optional[FaultSchedule]) -> None:
+        # Class-attr swap: takes effect for in-flight handler threads'
+        # next request/iteration too (they read self.faults each time).
+        self._handler_cls.faults = schedule
 
     @property
     def port(self) -> int:

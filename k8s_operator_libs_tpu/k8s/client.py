@@ -85,6 +85,16 @@ class ThrottledError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class ServerError(RuntimeError):
+    """HTTP 5xx: the apiserver (or something between) failed to serve the
+    request.  Transient by classification (retry.is_transient) — the
+    canonical breaker-opening failure when it persists."""
+
+    def __init__(self, message: str, status: int = 500) -> None:
+        super().__init__(message)
+        self.status = status
+
+
 class InvalidError(ValueError):
     """Object rejected by schema validation (HTTP 422 Invalid) — what a
     real apiserver returns when a CR violates its CRD's structural
@@ -267,6 +277,11 @@ class FakeCluster:
         # the call fail like a flaky apiserver (chaos-test knob — the
         # reference has no fault injection at all, SURVEY.md §5).
         self.fault_injector: Optional[Callable[[str], None]] = None
+        # Optional structured fault schedule (k8s.faults.FaultSchedule):
+        # consulted per verb after fault_injector; raises the mapped
+        # client exception (429/5xx/reset/timeout/409), and watch_drop
+        # rules end watch_events streams mid-flight.
+        self.fault_schedule = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -407,6 +422,13 @@ class FakeCluster:
         }
         try:
             while True:
+                schedule = self.fault_schedule
+                if schedule is not None:
+                    if schedule.decide_watch_drop("watch") is not None:
+                        # Injected stream drop: end the generator like a
+                        # server closing the connection — the consumer's
+                        # reconnect contract (re-list, re-watch) applies.
+                        return
                 # Snapshot BEFORE the timed get: an empty queue over the
                 # get window proves every event <= snapshot was already
                 # delivered, so the snapshot is a safe bookmark.  (Only
@@ -440,6 +462,8 @@ class FakeCluster:
             time.sleep(self.api_latency_s)
         if self.fault_injector is not None:
             self.fault_injector(verb)
+        if self.fault_schedule is not None:
+            self.fault_schedule.raise_for(verb)
 
     def on_pod_deleted(self, hook: Callable[[Pod], None]) -> None:
         """Register a hook fired after a pod is deleted/evicted (lets tests

@@ -40,8 +40,15 @@ from k8s_operator_libs_tpu.k8s.client import (
     ExpiredError,
     InvalidError,
     NotFoundError,
+    ServerError,
     ThrottledError,
     WatchEvent,
+)
+from k8s_operator_libs_tpu.k8s.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryPolicy,
+    is_transient,
 )
 from k8s_operator_libs_tpu.k8s.objects import (
     ContainerStatus,
@@ -382,13 +389,28 @@ class RestClient:
     # Idle keep-alive connections retained per client.
     POOL_SIZE = 8
 
-    def __init__(self, config: KubeConfig, timeout_s: float = 30.0) -> None:
+    def __init__(
+        self,
+        config: KubeConfig,
+        timeout_s: float = 30.0,
+        retry_policy: Optional["RetryPolicy"] = None,
+        breaker: Optional["CircuitBreaker"] = None,
+    ) -> None:
         self.config = config
         self.timeout_s = timeout_s
         # Chunk size for full lists (client-go pager default); lowered in
         # tests to exercise multi-chunk walks without thousand-node pools.
         self.list_chunk_size = 500
         self.stats: Counter = Counter()
+        # Classified retry + per-endpoint circuit breaking (see
+        # k8s.retry).  Either can be set to None post-construction to
+        # get raw single-attempt semantics.
+        self.retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy()
+        )
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # "retries" / "breaker_fast_fail" counters, for metrics.
+        self.retry_stats: Counter = Counter()
         self._token = config.token
         if not self._token and config.token_path:
             # Token supplied only as a file: read it now, not after the
@@ -545,7 +567,82 @@ class RestClient:
             return True
         return "disruption budget" in str(status.get("message", "")).lower()
 
+    def _may_retry(self, method: str, exc: BaseException) -> bool:
+        """Transient AND safe to re-send.  Non-POST verbs are idempotent
+        (PATCH carries absolute values, DELETE tolerates repeats).  A
+        POST is re-sent only when the server provably did not execute it:
+        a 429 throttle or a 503 rejection.  Connection-level failures on
+        a sent POST stay ambiguous (it may have executed) and are not
+        retried — same rule as the one-shot reconnect below."""
+        if not is_transient(exc):
+            return False
+        if method != "POST":
+            return True
+        if isinstance(exc, ThrottledError):
+            return True
+        return isinstance(exc, ServerError) and exc.status == 503
+
     def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[dict] = None,
+        body: Optional[dict] = None,
+        content_type: str = JSON,
+    ) -> dict:
+        """Classified-retry wrapper around :meth:`_request_once`.
+
+        Transient failures (429 throttle, 5xx, connection resets and
+        timeouts — see ``retry.is_transient``) are retried with capped
+        exponential backoff + jitter, honoring ``Retry-After``.  The
+        per-endpoint circuit breaker fast-fails with
+        :class:`CircuitOpenError` after sustained transient failure so a
+        reconcile tick against a dead apiserver costs microseconds, and
+        heals through half-open probes once the endpoint recovers."""
+        endpoint = self._stat_key(method, path)
+        policy = self.retry_policy
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow(endpoint):
+            self.retry_stats["breaker_fast_fail"] += 1
+            raise CircuitOpenError(endpoint, breaker.describe_open())
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                result = self._request_once(
+                    method, path, query=query, body=body,
+                    content_type=content_type,
+                )
+            except Exception as exc:  # noqa: BLE001 — classified below
+                transient = is_transient(exc)
+                if breaker is not None:
+                    if transient:
+                        breaker.record_failure(endpoint, exc)
+                    else:
+                        # A definitive server verdict (404/409/410/422,
+                        # PDB 429) proves the endpoint is alive.
+                        breaker.record_success(endpoint)
+                if not self._may_retry(method, exc):
+                    raise
+                if policy is None or attempt >= policy.max_attempts:
+                    raise
+                if breaker is not None and not breaker.allow(endpoint):
+                    self.retry_stats["breaker_fast_fail"] += 1
+                    raise CircuitOpenError(
+                        endpoint, breaker.describe_open()
+                    ) from exc
+                self.retry_stats["retries"] += 1
+                time.sleep(
+                    policy.backoff_s(
+                        attempt, getattr(exc, "retry_after_s", None)
+                    )
+                )
+                continue
+            if breaker is not None:
+                breaker.record_success(endpoint)
+            return result
+
+    def _request_once(
         self,
         method: str,
         path: str,
@@ -632,6 +729,11 @@ class RestClient:
                 after = 1.0
             raise ThrottledError(
                 f"{method} {path} throttled: {detail}", retry_after_s=after
+            )
+        if status >= 500:
+            raise ServerError(
+                f"apiserver {method} {path} -> {status}: {detail}",
+                status=status,
             )
         raise RuntimeError(
             f"apiserver {method} {path} -> {status}: {detail}"

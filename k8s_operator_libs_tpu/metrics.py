@@ -138,6 +138,19 @@ class UpgradeMetrics:
             "slice",
             "state",
         )
+        r.describe(
+            "api_circuit_open_endpoints",
+            "API endpoints whose circuit breaker is currently open "
+            "(>0 = reconcile degraded)",
+        )
+        r.describe(
+            "api_request_retries_total",
+            "Transient API failures retried by the client",
+        )
+        r.describe(
+            "api_breaker_fast_fails_total",
+            "API calls fast-failed because the endpoint circuit was open",
+        )
 
     def observe(self, manager, state, duration_s: float) -> None:
         r = self.registry
@@ -159,6 +172,23 @@ class UpgradeMetrics:
         r.set("upgrades_pending", manager.get_upgrades_pending(state))
         r.set("reconcile_duration_seconds", duration_s)
         r.inc("reconcile_total")
+        # Client resilience surface (present on RestClient and
+        # ResilientClient; absent on a bare FakeCluster).
+        client = getattr(manager, "client", None)
+        breaker = getattr(client, "breaker", None)
+        if breaker is not None and hasattr(breaker, "open_endpoints"):
+            r.set(
+                "api_circuit_open_endpoints", len(breaker.open_endpoints())
+            )
+        retry_stats = getattr(client, "retry_stats", None)
+        if retry_stats is not None:
+            r.set(
+                "api_request_retries_total", retry_stats.get("retries", 0)
+            )
+            r.set(
+                "api_breaker_fast_fails_total",
+                retry_stats.get("breaker_fast_fail", 0),
+            )
 
 
 class SliceUpgradeTimer:

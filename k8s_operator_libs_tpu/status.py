@@ -119,6 +119,19 @@ def gather(
     }
     if policy_section is not None:
         out["policy"] = policy_section
+    # Control-plane health: when the client carries a circuit breaker
+    # (RestClient / ResilientClient), surface open endpoints + retry
+    # counters — the operator-facing view of degraded mode.
+    breaker = getattr(client, "breaker", None)
+    if breaker is not None and hasattr(breaker, "open_endpoints"):
+        retry_stats = getattr(client, "retry_stats", None) or {}
+        out["apiHealth"] = {
+            "openCircuits": dict(breaker.open_endpoints()),
+            "retries": int(retry_stats.get("retries", 0)),
+            "breakerFastFails": int(
+                retry_stats.get("breaker_fast_fail", 0)
+            ),
+        }
     # Who is driving: the election Lease names the active controller
     # replica (empty/absent = single-replica mode or between terms).
     try:
@@ -204,6 +217,12 @@ def render(status: dict) -> str:
                     f"{c.get('status', ''):6s} {c.get('reason', '')}: "
                     f"{c.get('message', '')}"
                 )
+    api_health = status.get("apiHealth")
+    if api_health is not None and api_health.get("openCircuits"):
+        lines.append("")
+        lines.append("api health: DEGRADED (circuit open)")
+        for ep, err in sorted(api_health["openCircuits"].items()):
+            lines.append(f"  {ep}: {err}")
     warnings = status.get("recentWarnings") or []
     if warnings:
         lines.append("")

@@ -22,6 +22,7 @@ down either way.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -60,9 +61,15 @@ from k8s_operator_libs_tpu.upgrade.types import (
     NodeUpgradeState,
     UpgradeGroup,
 )
-from k8s_operator_libs_tpu.upgrade.util import EventRecorder, UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.util import (
+    EventRecorder,
+    StringSet,
+    UpgradeKeys,
+    WorkerTracker,
+)
 from k8s_operator_libs_tpu.upgrade.validation_manager import (
     PodValidationProber,
+    ProbeResult,
     SliceProber,
     ValidationManager,
 )
@@ -159,6 +166,25 @@ class ClusterUpgradeStateManager:
         # is cached for this window before re-probing.
         self.recovery_probe_backoff_s = 30.0
         self._recovery_rejections: dict[str, float] = {}
+        # The probe battery itself runs OFF the reconcile thread on the
+        # drain-manager async-worker pattern: process_upgrade_failed_groups
+        # schedules a worker per probe-eligible group (deduped by
+        # _recovery_inflight) and consumes cached healthy verdicts on a
+        # later pass, so the tick stays O(ms) regardless of prober type.
+        # State transitions still happen only on the reconcile thread.
+        self._recovery_tracker = WorkerTracker()
+        self._recovery_inflight = StringSet()
+        self._recovery_verdicts: dict[str, ProbeResult] = {}
+        self._recovery_lock = threading.Lock()
+        # When the client carries a circuit breaker (RestClient or
+        # ResilientClient), an open circuit is a progress blocker every
+        # group shares: surface it through stuck-state telemetry instead
+        # of letting ticks fail silently.
+        breaker = getattr(client, "breaker", None)
+        if breaker is not None and hasattr(breaker, "describe_open"):
+            self.stuck_detector.add_reason_source(
+                lambda _gid: breaker.describe_open() or None
+            )
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -695,7 +721,15 @@ class ClusterUpgradeStateManager:
         re-formation, ICI collectives), so recovering on pod sync alone
         would silently bless a slice the gate explicitly rejected (e.g.
         after a validation timeout — with pipelined validation that would
-        re-admit the workload onto unvalidated hardware)."""
+        re-admit the workload onto unvalidated hardware).
+
+        The probe battery is the one piece of device work this state
+        machine triggers, and it used to run synchronously here — a
+        sustained-collective prober would hold the reconcile tick for its
+        whole runtime.  It now runs on an async worker (drain-manager
+        pattern): this pass schedules the probe and moves on; a later
+        pass consumes the cached healthy verdict and performs the state
+        transition on the reconcile thread."""
         if validation_active is None:
             validation_active = self.is_validation_enabled()
         failed_ids = set()
@@ -704,17 +738,59 @@ class ClusterUpgradeStateManager:
             if not all(self._is_driver_pod_in_sync(m) for m in group.members):
                 continue
             if validation_active and self.validation_manager.prober is not None:
-                last = self._recovery_rejections.get(group.id)
-                now = time.monotonic()
-                if (
-                    last is not None
-                    and now - last < self.recovery_probe_backoff_s
-                ):
-                    # Recently rejected; don't re-run the battery yet.
+                with self._recovery_lock:
+                    verdict = self._recovery_verdicts.pop(group.id, None)
+                if verdict is None:
+                    self._maybe_schedule_recovery_probe(group)
                     continue
-                result = self.validation_manager.prober.probe(group)
+                # Healthy verdict cached by the worker: the transition
+                # below runs here, on the reconcile thread.
+                with self._recovery_lock:
+                    self._recovery_rejections.pop(group.id, None)
+            self._update_group_to_uncordon_or_done(group)
+        # Groups that left FAILED (recovered, deleted, or relabeled) must
+        # not pin a stale rejection — or a stale healthy verdict —
+        # against a future failure.
+        with self._recovery_lock:
+            for gid in list(self._recovery_rejections):
+                if gid not in failed_ids:
+                    del self._recovery_rejections[gid]
+            for gid in list(self._recovery_verdicts):
+                if gid not in failed_ids:
+                    del self._recovery_verdicts[gid]
+
+    def _maybe_schedule_recovery_probe(self, group: UpgradeGroup) -> None:
+        """Spawn the health-gate probe for a pod-synced FAILED group on a
+        worker thread, unless one is already in flight or a recent
+        rejection is still inside the backoff window."""
+        if not self._recovery_inflight.try_add(group.id):
+            return  # probe already running for this group
+        with self._recovery_lock:
+            last = self._recovery_rejections.get(group.id)
+        if (
+            last is not None
+            and time.monotonic() - last < self.recovery_probe_backoff_s
+        ):
+            # Recently rejected; don't re-run the battery yet.
+            self._recovery_inflight.remove(group.id)
+            return
+        prober = self.validation_manager.prober
+
+        def _probe() -> None:
+            try:
+                try:
+                    result = prober.probe(group)
+                except Exception as e:  # noqa: BLE001 — verdict, not crash
+                    result = ProbeResult(
+                        False, f"recovery probe raised: {type(e).__name__}: {e}"
+                    )
+                with self._recovery_lock:
+                    if result.healthy:
+                        self._recovery_verdicts[group.id] = result
+                        self._recovery_rejections.pop(group.id, None)
+                    else:
+                        self._recovery_rejections[group.id] = time.monotonic()
                 if not result.healthy:
-                    self._recovery_rejections[group.id] = now
                     logger.info(
                         "failed group %s stays failed: health gate "
                         "rejects recovery: %s (next probe in %.0fs)",
@@ -722,14 +798,19 @@ class ClusterUpgradeStateManager:
                         result.detail,
                         self.recovery_probe_backoff_s,
                     )
-                    continue
-                self._recovery_rejections.pop(group.id, None)
-            self._update_group_to_uncordon_or_done(group)
-        # Groups that left FAILED (recovered, deleted, or relabeled) must
-        # not pin a stale rejection against a future failure.
-        for gid in list(self._recovery_rejections):
-            if gid not in failed_ids:
-                del self._recovery_rejections[gid]
+            finally:
+                self._recovery_inflight.remove(group.id)
+
+        try:
+            self._recovery_tracker.spawn(
+                _probe, name=f"recovery-probe-{group.id}"
+            )
+        except Exception:
+            # A failed spawn must not strand the in-flight claim (the
+            # same leak shape as the rollback-spawn fix in
+            # validation_manager._schedule_rollback_eviction).
+            self._recovery_inflight.remove(group.id)
+            raise
 
     def process_validation_required_groups(
         self, state: ClusterUpgradeState, validation_active: Optional[bool] = None
@@ -787,10 +868,16 @@ class ClusterUpgradeStateManager:
             group.id, None
         )
         # Recovery re-validated the hardware, so a still-pending rollback
-        # eviction is moot — stop tracking/retrying it.
-        getattr(self.validation_manager, "pending_rollback", {}).pop(
-            group.id, None
-        )
+        # eviction is moot — stop tracking/retrying it.  The helper also
+        # clears the retry-backoff stamp, so a FUTURE failure of this
+        # group isn't silently delayed by this (resolved) one's backoff.
+        clear = getattr(self.validation_manager, "clear_pending_rollback", None)
+        if clear is not None:
+            clear(group.id)
+        else:  # injected fakes may predate the helper
+            getattr(self.validation_manager, "pending_rollback", {}).pop(
+                group.id, None
+            )
         key = self.keys.initial_state_annotation
         if all(key in m.node.annotations for m in group.members):
             self.provider.change_nodes_upgrade_state(
@@ -1008,10 +1095,12 @@ class ClusterUpgradeStateManager:
 
     def wait_for_async_work(self, timeout_s: float = 30.0) -> bool:
         """Join outstanding drain/eviction workers (including the
-        validation manager's rollback-eviction workers)."""
+        validation manager's rollback-eviction workers) and any in-flight
+        failed-group recovery probes."""
         ok = self.drain_manager.wait_idle(timeout_s)
         ok = self.pod_manager.wait_idle(timeout_s) and ok
         wait = getattr(self.validation_manager, "wait_idle", None)
         if wait is not None:  # injected fakes may lack it
             ok = wait(timeout_s) and ok
+        ok = self._recovery_tracker.wait_idle(timeout_s) and ok
         return ok

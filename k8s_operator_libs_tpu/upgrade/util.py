@@ -38,6 +38,16 @@ class StringSet:
         with self._mu:
             self._items.add(item)
 
+    def try_add(self, item: str) -> bool:
+        """Atomically add; False when already present (claim semantics —
+        lets schedulers dedupe in-flight work without a check-then-act
+        race)."""
+        with self._mu:
+            if item in self._items:
+                return False
+            self._items.add(item)
+            return True
+
     def remove(self, item: str) -> None:
         with self._mu:
             self._items.discard(item)

@@ -143,6 +143,20 @@ class ValidationManager:
         # the engine's recovery_probe_backoff_s.
         self.rollback_retry_backoff_s = 30.0
         self._rollback_last_attempt: dict[str, float] = {}
+        # group id -> node names whose eviction failed on the last
+        # attempt: the completion Normal event fires only for nodes that
+        # actually had a failure to close out (not the whole group).
+        self._rollback_failed_nodes: dict[str, list[str]] = {}
+
+    def clear_pending_rollback(self, group_id: str) -> None:
+        """Stop tracking a group's pending rollback eviction: clears the
+        blocker record AND the retry-backoff stamp (and the failed-node
+        list).  Popping only ``pending_rollback`` — the old recovery-path
+        behavior — left the backoff stamp behind, silently delaying the
+        group's NEXT failure's first rollback retry by a stale window."""
+        self.pending_rollback.pop(group_id, None)
+        self._rollback_last_attempt.pop(group_id, None)
+        self._rollback_failed_nodes.pop(group_id, None)
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -265,10 +279,21 @@ class ValidationManager:
                         f"({', '.join(n for n, _ in failures)}): "
                         f"{failures[0][1]}"
                     )
+                    self._rollback_failed_nodes[group.id] = [
+                        n for n, _ in failures
+                    ]
                 elif self.pending_rollback.pop(group.id, None) is not None:
                     # A previously-blocked eviction finally completed:
-                    # close the loop for the operator watching events.
-                    for name in node_names:
+                    # close the loop for the operator watching events —
+                    # on the nodes that actually had a failure to close
+                    # out, not the whole group (nodes that drained clean
+                    # on the first attempt never warned, so a completion
+                    # Normal there would be noise with no Warning pair).
+                    healed = self._rollback_failed_nodes.pop(
+                        group.id, None
+                    )
+                    self._rollback_last_attempt.pop(group.id, None)
+                    for name in healed if healed is not None else node_names:
                         log_event(
                             self.event_recorder,
                             name,
@@ -287,7 +312,18 @@ class ValidationManager:
                 "re-attempting blocked rollback eviction for group %s",
                 group.id,
             )
-        self._tracker.spawn(_rollback, name=f"validation-rollback-{group.id}")
+        try:
+            self._tracker.spawn(
+                _rollback, name=f"validation-rollback-{group.id}"
+            )
+        except Exception:
+            # A failed spawn (thread limit, interpreter shutdown) must
+            # not strand the active claim: that would silently skip
+            # every future retry for this group while workload pods sit
+            # on gate-rejected hardware.
+            with self._rollback_lock:
+                self._rollback_active.discard(group.id)
+            raise
 
     def retry_pending_rollbacks(self, state) -> None:
         """Re-attempt rollback evictions that previously failed, for
@@ -302,8 +338,7 @@ class ValidationManager:
         for gid in list(self.pending_rollback):
             group = failed.get(gid)
             if group is None:
-                self.pending_rollback.pop(gid, None)
-                self._rollback_last_attempt.pop(gid, None)
+                self.clear_pending_rollback(gid)
                 continue
             last = self._rollback_last_attempt.get(gid)
             if (

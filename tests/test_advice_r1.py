@@ -223,7 +223,10 @@ def test_agent_healthy_report_carries_device_count(cpu_devices):
 
 
 def test_pyproject_declares_dependencies():
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python < 3.11: the backport is API-identical
+        import tomli as tomllib
 
     with open("/root/repo/pyproject.toml", "rb") as f:
         project = tomllib.load(f)["project"]

@@ -143,6 +143,40 @@ def test_oversized_protected_values_still_fit():
     assert parsed["details"]["error"].startswith("e")
 
 
+def test_oversized_nonstring_protected_values_still_fit():
+    """A protected key carrying a non-string payload (a LIST of
+    traceback strings smuggled under 'error') used to defeat the
+    last-resort shrink loop, which only halves strings — the cap must
+    hold unconditionally regardless of value type."""
+    line = compact_line(
+        METRIC,
+        0.0,
+        "s",
+        0.0,
+        {
+            "complete": False,
+            "error": ["traceback line " + "x" * 400 for _ in range(50)],
+            "backend": {"nested": ["deep"] * 500},
+        },
+    )
+    assert len(line.encode()) <= MAX_LINE_BYTES
+    parsed = json.loads(line)
+    assert parsed["value"] == 0.0
+    assert parsed["metric"]  # headline survives whatever details did
+
+
+def test_cap_holds_for_pathological_key_shapes():
+    """Hundreds of wide expendable keys (shapes no shrink rule targets,
+    only the drop rule) must still resolve to a parseable capped line."""
+    summary = {f"k{i}" * 20: True for i in range(400)}
+    summary["complete"] = True
+    line = compact_line(METRIC, 1.5, "s", 2.0, summary)
+    assert len(line.encode()) <= MAX_LINE_BYTES
+    parsed = json.loads(line)
+    assert parsed["value"] == 1.5
+    assert parsed["details"]["complete"] is True
+
+
 def test_emit_splits_bulk_to_side_file(tmp_path, capsys):
     """An r4-sized details payload (full transition histories) must land
     in the side file, never on stdout."""

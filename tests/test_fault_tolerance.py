@@ -1,0 +1,761 @@
+"""Control-plane fault-tolerance layer: injectable faults, classified
+retry + circuit breaking, degraded-mode surfacing, and the async
+recovery prober.
+
+Four surfaces, pinned together because they form one contract:
+
+1. :class:`FaultSchedule` — the programmable fault plan both tiers
+   consume (FakeCluster raises mapped client exceptions; KubeApiServer
+   synthesizes the wire shapes: 429+Retry-After, 5xx Status, RST,
+   stalled response, dropped watch stream).
+2. The retry layer — ``is_transient`` taxonomy, capped-exponential
+   backoff honoring Retry-After, per-endpoint :class:`CircuitBreaker`
+   with half-open probing, and :class:`ResilientClient` giving the fake
+   tier the same policy code ``RestClient`` applies internally.
+3. The controller degrading gracefully: an open circuit surfaces a
+   Degraded condition (reason ``ApiCircuitOpen``) on the policy CR and
+   reconcile keeps ticking instead of crashing.
+4. The recovery probe battery running off-thread (drain-manager
+   pattern): a deliberately slow prober must not stretch the reconcile
+   tick, and the spawn/claim bookkeeping must not leak.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.api.schema import register_policy_crd
+from k8s_operator_libs_tpu.controller import (
+    ControllerConfig,
+    UpgradeController,
+)
+from k8s_operator_libs_tpu.k8s import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ConflictError,
+    FakeCluster,
+    Fault,
+    FaultRule,
+    FaultSchedule,
+    KubeApiServer,
+    KubeConfig,
+    NotFoundError,
+    ResilientClient,
+    RestClient,
+    RetryPolicy,
+    ServerError,
+    ThrottledError,
+    is_transient,
+)
+from k8s_operator_libs_tpu.k8s.client import (
+    EvictionBlockedError,
+    ExpiredError,
+    InvalidError,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    ProbeResult,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+from tests.test_policy_cr import GVP, _cr
+
+KEYS = UpgradeKeys()
+
+
+def _fast_policy(**kw) -> RetryPolicy:
+    kw.setdefault("base_backoff_s", 0.001)
+    kw.setdefault("max_backoff_s", 0.01)
+    kw.setdefault("jitter", 0.0)
+    return RetryPolicy(**kw)
+
+
+# -- FaultSchedule ----------------------------------------------------------
+
+
+class TestFaultSchedule:
+    def test_window_semantics_skip_then_budget(self):
+        """skip lets the first N matching calls through, max_hits ends
+        the outage — together they express a deterministic window."""
+        s = FaultSchedule().server_error("get", skip=2, max_hits=3)
+        outcomes = [s.decide("get_node") is not None for _ in range(8)]
+        assert outcomes == [False, False, True, True, True, False, False,
+                            False]
+        assert s.hits["get_node"] == 3
+
+    def test_first_firing_rule_wins_and_misses_pass_through(self):
+        s = (
+            FaultSchedule()
+            .throttle("patch", retry_after_s=0.5)
+            .server_error("patch", status=503)
+        )
+        fault = s.decide("patch_node_labels")
+        assert fault is not None and fault.kind == "throttle"
+        assert s.decide("list_pods") is None
+
+    def test_probability_is_seeded_and_reproducible(self):
+        def run(seed):
+            s = FaultSchedule(seed=seed).server_error("get", probability=0.5)
+            return [s.decide("get_node") is not None for _ in range(20)]
+
+        assert run(7) == run(7)
+        assert any(run(7)) and not all(run(7))
+
+    def test_watch_drop_rules_isolated_from_unary_verbs(self):
+        """Stream loops poll decide_watch_drop every heartbeat; unary
+        rules' budgets must not be consumed by those polls, nor may a
+        watch_drop budget be burned by regular verbs."""
+        s = (
+            FaultSchedule()
+            .throttle("", retry_after_s=0.1, max_hits=1)
+            .watch_drop(max_hits=1)
+        )
+        # Heartbeat polls: only the watch_drop rule is consulted.
+        assert s.decide_watch_drop("watch") is not None
+        assert s.decide_watch_drop("watch") is None  # budget spent
+        # Unary call: the throttle budget is still intact.
+        assert s.decide("get_node").kind == "throttle"
+        assert s.decide("get_node") is None
+
+    def test_clear_ends_all_faults(self):
+        s = FaultSchedule().server_error("")
+        assert s.decide("get_node") is not None
+        s.clear()
+        assert s.decide("get_node") is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultRule(kind="chaos-monkey")
+
+    def test_on_fault_hook_observes_injections(self):
+        seen: list[tuple[str, Fault]] = []
+        s = FaultSchedule().conflict("patch", max_hits=1)
+        s.on_fault = lambda verb, fault: seen.append((verb, fault))
+        s.decide("patch_node_labels")
+        assert seen and seen[0][0] == "patch_node_labels"
+        assert seen[0][1].kind == "conflict"
+
+
+class TestFakeTierInjection:
+    def _cluster(self, schedule):
+        c = FakeCluster()
+        fx = ClusterFixture(c, KEYS)
+        fx.node()
+        c.fault_schedule = schedule
+        return c
+
+    def test_raise_mapping_per_kind(self):
+        c = self._cluster(None)
+        name = c.list_nodes()[0].name
+        cases = [
+            ("throttle", ThrottledError),
+            ("error", ServerError),
+            ("reset", ConnectionResetError),
+            ("timeout", TimeoutError),
+            ("conflict", ConflictError),
+        ]
+        for kind, exc_type in cases:
+            c.fault_schedule = FaultSchedule().add(
+                FaultRule(match="get_node", kind=kind, max_hits=1)
+            )
+            with pytest.raises(exc_type):
+                c.get_node(name)
+            # Budget spent: the next call succeeds.
+            assert c.get_node(name).name == name
+
+    def test_throttle_carries_retry_after(self):
+        c = self._cluster(
+            FaultSchedule().throttle("get_node", retry_after_s=2.5,
+                                     max_hits=1)
+        )
+        with pytest.raises(ThrottledError) as exc:
+            c.get_node(c.list_nodes()[0].name)
+        assert exc.value.retry_after_s == 2.5
+
+    def test_faults_fire_before_the_store_mutates(self):
+        """An injected fault on a write must leave the object untouched —
+        retrying the write is then always safe on this tier."""
+        c = self._cluster(
+            FaultSchedule().server_error("patch_node", max_hits=1)
+        )
+        name = c.list_nodes()[0].name
+        with pytest.raises(ServerError):
+            c.patch_node_labels(name, {"x": "y"})
+        assert "x" not in c.get_node(name, cached=False).labels
+
+    def test_watch_drop_ends_stream_for_reconnect(self):
+        c = self._cluster(FaultSchedule().watch_drop(max_hits=1))
+        # The drop ends the generator (server closed the stream); a
+        # fresh watch_events call succeeds — the re-list/re-watch
+        # reconnect contract.
+        events = list(c.watch_events(kinds=["Node"]))
+        assert events == []
+        gen = c.watch_events(kinds=["Node"])
+        assert next(gen) is None  # live again: idle heartbeat
+        gen.close()
+
+
+# -- taxonomy / backoff / breaker ------------------------------------------
+
+
+def test_is_transient_taxonomy():
+    transient = [
+        ThrottledError("429", retry_after_s=1.0),
+        ServerError("boom", status=503),
+        ConnectionResetError("rst"),
+        TimeoutError("deadline"),
+        OSError("refused"),
+    ]
+    fatal = [
+        NotFoundError("404"),
+        ConflictError("409"),
+        ExpiredError("410"),
+        InvalidError("422", causes=[]),
+        EvictionBlockedError("pdb"),
+        CircuitOpenError("GET nodes"),
+    ]
+    assert all(is_transient(e) for e in transient)
+    assert not any(is_transient(e) for e in fatal)
+
+
+def test_backoff_grows_caps_and_honors_retry_after():
+    p = RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0, jitter=0.0)
+    assert p.backoff_s(1) == pytest.approx(0.1)
+    assert p.backoff_s(2) == pytest.approx(0.2)
+    assert p.backoff_s(3) == pytest.approx(0.4)
+    assert p.backoff_s(10) == pytest.approx(1.0)  # capped
+    # Retry-After raises the floor...
+    assert p.backoff_s(1, retry_after_s=0.7) == pytest.approx(0.7)
+    # ...but a hostile Retry-After cannot exceed the cap and wedge the
+    # tick.
+    assert p.backoff_s(1, retry_after_s=3600.0) == pytest.approx(1.0)
+    # Jitter stays within its band.
+    pj = RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0, jitter=0.2,
+                     seed=1)
+    for attempt in (1, 2, 3):
+        base = min(1.0, 0.1 * 2 ** (attempt - 1))
+        assert abs(pj.backoff_s(attempt) - base) <= base * 0.2 + 1e-9
+
+
+class TestCircuitBreaker:
+    def test_open_halfopen_close_cycle(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                           clock=lambda: clock[0])
+        ep = "GET nodes"
+        for _ in range(2):
+            b.record_failure(ep, TimeoutError("t"))
+        assert b.allow(ep)  # below threshold: closed
+        b.record_failure(ep, TimeoutError("t"))
+        assert not b.allow(ep)  # open: fast-fail
+        assert ep in b.open_endpoints()
+        assert "api circuit open" in b.describe_open()
+        # Half-open: exactly one probe per reset window.
+        clock[0] = 10.0
+        assert b.allow(ep)
+        assert not b.allow(ep)  # second caller still fast-fails
+        # Failed probe re-opens and restarts the clock.
+        b.record_failure(ep, TimeoutError("still down"))
+        clock[0] = 19.0
+        assert not b.allow(ep)
+        clock[0] = 20.0
+        assert b.allow(ep)
+        b.record_success(ep)
+        assert b.allow(ep) and b.allow(ep)  # closed again
+        assert b.open_endpoints() == {}
+        assert b.describe_open() == ""
+
+    def test_definitive_verdict_resets_the_count(self):
+        """Interleaved 404s prove the endpoint is alive: consecutive
+        transient failures, not cumulative ones, open the circuit."""
+        b = CircuitBreaker(failure_threshold=3)
+        ep = "GET nodes"
+        for _ in range(5):
+            b.record_failure(ep, TimeoutError("t"))
+            b.record_success(ep)  # a 404 landed in between
+        assert b.allow(ep)
+
+    def test_endpoints_are_independent(self):
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=60.0)
+        b.record_failure("GET nodes", TimeoutError("t"))
+        assert not b.allow("GET nodes")
+        assert b.allow("PATCH pods")
+
+    def test_last_error_is_bounded(self):
+        b = CircuitBreaker(failure_threshold=1)
+        b.record_failure("GET nodes", ServerError("x" * 10_000, status=500))
+        (err,) = b.open_endpoints().values()
+        assert len(err) <= 160
+
+
+# -- ResilientClient (fake tier policy parity) ------------------------------
+
+
+class TestResilientClient:
+    def _wrapped(self, schedule, **breaker_kw):
+        c = FakeCluster()
+        fx = ClusterFixture(c, KEYS)
+        node = fx.node()
+        c.fault_schedule = schedule
+        rc = ResilientClient(
+            c,
+            retry_policy=_fast_policy(),
+            breaker=CircuitBreaker(**breaker_kw) if breaker_kw else None,
+        )
+        return c, rc, node.name
+
+    def test_transient_faults_are_retried_to_success(self):
+        _, rc, name = self._wrapped(
+            FaultSchedule().throttle("get_node", retry_after_s=0.0,
+                                     max_hits=2)
+        )
+        assert rc.get_node(name).name == name
+        assert rc.retry_stats["retries"] == 2
+
+    def test_fatal_errors_pass_through_unretried(self):
+        _, rc, _ = self._wrapped(FaultSchedule())
+        with pytest.raises(NotFoundError):
+            rc.get_node("no-such-node")
+        assert rc.retry_stats["retries"] == 0
+        assert rc.breaker.allow("get_node")
+
+    def test_circuit_opens_fast_fails_and_heals(self):
+        schedule = FaultSchedule().server_error("get_node", status=503)
+        _, rc, name = self._wrapped(
+            schedule, failure_threshold=3, reset_timeout_s=0.05
+        )
+        with pytest.raises((ServerError, CircuitOpenError)):
+            rc.get_node(name)
+        # Circuit open: fast-fail without touching the inner client.
+        with pytest.raises(CircuitOpenError):
+            rc.get_node(name)
+        assert rc.retry_stats["breaker_fast_fail"] >= 1
+        assert "get_node" in rc.breaker.open_endpoints()
+        # Faults clear; after the reset window the half-open probe heals.
+        schedule.clear()
+        time.sleep(0.06)
+        assert rc.get_node(name).name == name
+        assert rc.breaker.open_endpoints() == {}
+
+    def test_watch_and_private_attrs_pass_through(self):
+        c, rc, _ = self._wrapped(FaultSchedule())
+        assert rc.watch_events.__func__ is c.watch_events.__func__
+        assert rc._lock is c._lock
+
+    def test_monkeypatched_inner_verbs_stay_visible(self):
+        """Wrappers are rebuilt per access: tests that wrap inner-client
+        verbs (e.g. the transition recorder) must see their wrapper used,
+        not a cached stale bound method."""
+        c, rc, name = self._wrapped(FaultSchedule())
+        calls = []
+        orig = c.patch_node_labels
+        c.patch_node_labels = lambda *a, **k: (calls.append(a), orig(*a, **k))[1]
+        rc.patch_node_labels(name, {"k": "v"})
+        assert len(calls) == 1
+
+
+# -- wire tier --------------------------------------------------------------
+
+
+class WireFixture:
+    def __init__(self, schedule=None, **client_kw):
+        self.store = FakeCluster()
+        fx = ClusterFixture(self.store, KEYS)
+        self.node = fx.node()
+        self.server = KubeApiServer(self.store, fault_schedule=schedule)
+        self.client_kw = client_kw
+
+    def __enter__(self):
+        self.server.__enter__()
+        self.client = RestClient(
+            KubeConfig(host=self.server.host), timeout_s=5.0,
+            **self.client_kw,
+        )
+        return self
+
+    def __exit__(self, *exc):
+        return self.server.__exit__(*exc)
+
+
+class TestWireTierInjection:
+    def test_throttle_storm_is_retried_with_retry_after(self):
+        schedule = FaultSchedule().throttle(
+            "GET /api/v1/nodes", retry_after_s=0.01, max_hits=2
+        )
+        with WireFixture(schedule, retry_policy=_fast_policy()) as w:
+            assert w.client.get_node(w.node.name).name == w.node.name
+            assert w.client.retry_stats["retries"] == 2
+            assert schedule.hits[f"GET /api/v1/nodes/{w.node.name}"] == 2
+
+    def test_connection_reset_is_absorbed(self):
+        schedule = FaultSchedule().connection_reset(
+            "GET /api/v1/nodes", max_hits=1
+        )
+        with WireFixture(schedule, retry_policy=_fast_policy()) as w:
+            assert w.client.get_node(w.node.name).name == w.node.name
+
+    def test_conflict_storm_is_fatal_not_retried(self):
+        schedule = FaultSchedule().conflict("PATCH", max_hits=1)
+        with WireFixture(schedule, retry_policy=_fast_policy()) as w:
+            with pytest.raises(ConflictError):
+                w.client.patch_node_labels(w.node.name, {"a": "b"})
+            assert w.client.retry_stats["retries"] == 0
+            # The 409 was a definitive verdict: the breaker stays closed.
+            assert w.client.breaker.open_endpoints() == {}
+
+    def test_outage_opens_breaker_then_half_open_heals(self):
+        schedule = FaultSchedule().server_error(
+            "GET /api/v1/nodes", status=503
+        )
+        with WireFixture(
+            schedule,
+            retry_policy=_fast_policy(max_attempts=3),
+            breaker=CircuitBreaker(failure_threshold=3,
+                                   reset_timeout_s=0.05),
+        ) as w:
+            with pytest.raises((ServerError, CircuitOpenError)):
+                w.client.get_node(w.node.name)
+            with pytest.raises(CircuitOpenError):
+                w.client.get_node(w.node.name)
+            assert w.client.retry_stats["breaker_fast_fail"] >= 1
+            schedule.clear()
+            time.sleep(0.06)
+            assert w.client.get_node(w.node.name).name == w.node.name
+            assert w.client.breaker.open_endpoints() == {}
+
+    def test_sent_posts_never_blind_retry_on_connection_faults(self):
+        """An eviction POST whose connection resets is ambiguous (the
+        server may have executed it) — the client must surface the error,
+        not blind-retry."""
+        schedule = FaultSchedule().connection_reset("POST", max_hits=1)
+        with WireFixture(schedule, retry_policy=_fast_policy()) as w:
+            fx = ClusterFixture(w.store, KEYS)
+            pod = fx.workload_pod(w.node, name="victim")
+            with pytest.raises(OSError):
+                w.client.evict_pod(pod.namespace, pod.name)
+            assert w.client.retry_stats["retries"] == 0
+
+    def test_watch_drop_surfaces_for_reconnect(self):
+        """An injected drop closes the chunked stream with a clean
+        terminator; the client surfaces the closure (RuntimeError — the
+        re-list/re-watch contract, not a silent end that would degrade
+        --watch to polling), and a reconnect succeeds once the budget
+        is spent."""
+        schedule = FaultSchedule().watch_drop(max_hits=1)
+        with WireFixture(schedule) as w:
+            gen = w.client.watch_events(kinds=["Node"])
+            with pytest.raises(RuntimeError, match="closed the stream"):
+                for ev in gen:
+                    assert ev is None or ev.kind == "Node"
+            gen2 = w.client.watch_events(kinds=["Node"])
+            assert next(gen2) is None
+            gen2.close()
+
+
+# -- controller degraded mode ----------------------------------------------
+
+
+def _controller_with_cr(client, store):
+    register_policy_crd(store)
+    store.create_custom_object(
+        *GVP,
+        NAMESPACE,
+        _cr(autoUpgrade=True, drain={"enable": True, "timeoutSeconds": 5}),
+    )
+    config = ControllerConfig(
+        namespace=NAMESPACE,
+        driver_labels=DRIVER_LABELS,
+        interval_s=0.01,
+        policy=None,
+        policy_ref=(NAMESPACE, "upgrade-policy"),
+        hbm_floor_fraction=0.0,
+        publish_events=False,
+    )
+    controller = UpgradeController(client, config)
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    return controller
+
+
+def test_controller_surfaces_degraded_while_circuit_open_then_recovers():
+    """An outage scoped to the nodes endpoints opens the breaker; the
+    pass degrades (no crash), the policy CR gains Degraded=True with
+    reason ApiCircuitOpen, and once the faults clear the half-open probe
+    heals the path and Degraded returns to False."""
+    store = FakeCluster()
+    fx = ClusterFixture(store, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    # Outage on node/pod list verbs only: the CR status write must still
+    # land while the breaker is open.
+    schedule = (
+        FaultSchedule()
+        .server_error("list_nodes", status=503)
+        .server_error("list_page", status=503)
+        .server_error("list_pods", status=503)
+    )
+    store.fault_schedule = schedule
+    client = ResilientClient(
+        store,
+        retry_policy=_fast_policy(max_attempts=2),
+        breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05),
+    )
+    controller = _controller_with_cr(client, store)
+
+    assert controller.reconcile_once() is False  # degraded, not a crash
+    status = store.get_custom_object(*GVP, NAMESPACE, "upgrade-policy")[
+        "status"
+    ]
+    assert status["apiCircuitOpenEndpoints"] >= 1
+    conds = {c["type"]: c for c in status["conditions"]}
+    assert conds["Degraded"]["status"] == "True"
+    assert conds["Degraded"]["reason"] == "ApiCircuitOpen"
+    assert "circuit-open" in conds["Degraded"]["message"]
+    # The breaker doubles as a stuck-detector reason source.
+    assert "api circuit open" in client.breaker.describe_open()
+    # Metrics surface the degradation without a successful pass.
+    rendered = controller.metrics.registry.render()
+    assert "api_circuit_open_endpoints 1" in rendered
+
+    schedule.clear()
+    time.sleep(0.06)  # past the breaker reset window
+    assert controller.reconcile_once() is True
+    status = store.get_custom_object(*GVP, NAMESPACE, "upgrade-policy")[
+        "status"
+    ]
+    assert status["apiCircuitOpenEndpoints"] == 0
+    conds = {c["type"]: c for c in status["conditions"]}
+    assert conds["Degraded"]["status"] == "False"
+
+
+def test_conditions_degraded_reason_precedence():
+    """Failed slices outrank an open circuit as the Degraded reason, but
+    both are mentioned; an open circuit alone reads ApiCircuitOpen."""
+    base = {
+        "upgradesInProgress": 0,
+        "upgradesPending": 0,
+        "upgradesDone": 0,
+        "totalManagedNodes": 4,
+    }
+    both = dict(base, upgradesFailed=2, apiCircuitOpenEndpoints=1)
+    conds = {c["type"]: c for c in UpgradeController._conditions(both, [])}
+    assert conds["Degraded"]["reason"] == "SlicesFailed"
+    assert "circuit-open" in conds["Degraded"]["message"]
+    circuit_only = dict(base, upgradesFailed=0, apiCircuitOpenEndpoints=2)
+    conds = {
+        c["type"]: c
+        for c in UpgradeController._conditions(circuit_only, [])
+    }
+    assert conds["Degraded"]["status"] == "True"
+    assert conds["Degraded"]["reason"] == "ApiCircuitOpen"
+    # Complete stays keyed on upgrade progress, not API health.
+    assert conds["Complete"]["status"] == "True"
+
+
+def test_status_cli_reports_api_health():
+    from k8s_operator_libs_tpu.status import gather, render
+
+    store = FakeCluster()
+    fx = ClusterFixture(store, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    n = fx.node(state=UpgradeState.DONE)
+    fx.driver_pod(n, ds, hash_suffix="v1")
+    client = ResilientClient(store, retry_policy=_fast_policy())
+    client.breaker.failure_threshold = 1
+    # Open a circuit on a verb the read-only snapshot never calls, so
+    # the gather itself still works while degraded.
+    client.breaker.record_failure("evict_pod", TimeoutError("api down"))
+    out = gather(client, NAMESPACE, DRIVER_LABELS, keys=KEYS)
+    assert out["apiHealth"]["openCircuits"]
+    text = render(out)
+    assert "api health: DEGRADED (circuit open)" in text
+    assert "evict_pod" in text
+
+
+# -- async recovery prober --------------------------------------------------
+
+
+class SlowHealthyProber:
+    """A sustained-collective battery standing in: each probe takes
+    ``delay_s`` of wall-clock and then reports healthy."""
+
+    def __init__(self, delay_s: float) -> None:
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def probe(self, group) -> ProbeResult:
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return ProbeResult(True, "healthy after sustained battery")
+
+
+def _failed_synced_group(prober):
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="h2", revision=2)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    for n in nodes:
+        c.patch_node_labels(
+            n.name, {KEYS.state_label: UpgradeState.FAILED.value}
+        )
+        fx.driver_pod(n, ds, hash_suffix="h2")
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(prober)
+    mgr.recovery_probe_backoff_s = 0.0
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        health_gate=SliceHealthGateSpec(enable=True, timeout_second=600),
+    )
+    return c, mgr, policy, nodes
+
+
+def test_slow_prober_does_not_stretch_the_reconcile_tick():
+    """The tentpole latency claim: with a 0.5s probe battery, the
+    scheduling pass stays O(ms) — the battery runs off-thread and a
+    later pass consumes the cached verdict."""
+    prober = SlowHealthyProber(delay_s=0.5)
+    c, mgr, policy, nodes = _failed_synced_group(prober)
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    t0 = time.monotonic()
+    mgr.apply_state(state, policy)
+    tick_s = time.monotonic() - t0
+    assert tick_s < 0.25, (
+        f"reconcile tick took {tick_s:.3f}s — the probe battery is "
+        "running on the reconcile thread"
+    )
+    # The battery really ran (off-thread), and the verdict lands on a
+    # later pass.
+    assert mgr.wait_for_async_work(10.0)
+    assert prober.calls == 1
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert state_of(c, KEYS, nodes[0].name) == (
+        UpgradeState.UNCORDON_REQUIRED.value
+    )
+
+
+def test_concurrent_passes_dedupe_inflight_probes():
+    """Reconcile passes arriving while a probe is in flight must not
+    stack additional probes for the same group."""
+    prober = SlowHealthyProber(delay_s=0.3)
+    c, mgr, policy, _ = _failed_synced_group(prober)
+    for _ in range(4):
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert mgr.wait_for_async_work(10.0)
+    assert prober.calls == 1
+
+
+def test_prober_exception_is_a_rejection_not_a_crash():
+    class RaisingProber:
+        def probe(self, group):
+            raise RuntimeError("ICI collective wedged")
+
+    c, mgr, policy, nodes = _failed_synced_group(RaisingProber())
+    mgr.recovery_probe_backoff_s = 30.0
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert mgr.wait_for_async_work(10.0)
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert state_of(c, KEYS, nodes[0].name) == UpgradeState.FAILED.value
+    gid = next(iter(mgr._recovery_rejections))
+    assert gid  # rejection cached for the backoff window
+
+
+def test_recovery_spawn_failure_does_not_strand_the_claim():
+    """The leak shape the rollback-spawn fix closed, pinned on the
+    recovery path too: a failed worker spawn must release the in-flight
+    claim or every future probe for that group is silently skipped."""
+    prober = SlowHealthyProber(delay_s=0.0)
+    c, mgr, policy, _ = _failed_synced_group(prober)
+
+    def exploding_spawn(*a, **k):
+        raise RuntimeError("thread limit reached")
+
+    mgr._recovery_tracker.spawn = exploding_spawn
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    group = state.groups_in(UpgradeState.FAILED)[0]
+    with pytest.raises(RuntimeError, match="thread limit"):
+        mgr._maybe_schedule_recovery_probe(group)
+    assert not mgr._recovery_inflight.has(group.id)
+
+
+def test_rollback_spawn_failure_does_not_strand_the_claim():
+    """Same invariant on the validation-rollback worker (the original
+    leak): a failed spawn must release _rollback_active so later passes
+    can re-attempt the eviction."""
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(SlowHealthyProber(0.0))
+    vm = mgr.validation_manager
+
+    def exploding_spawn(*a, **k):
+        raise RuntimeError("thread limit reached")
+
+    vm._tracker.spawn = exploding_spawn
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    group = state.all_groups()[0]
+    with pytest.raises(RuntimeError, match="thread limit"):
+        vm._schedule_rollback_eviction(group)
+    assert group.id not in vm._rollback_active
+
+
+def test_clear_pending_rollback_clears_all_bookkeeping():
+    """Recovery mooting a pending rollback must clear the retry-backoff
+    and failed-node records too, or a later failure of the same group
+    inherits a stale backoff stamp (delayed first retry) and a stale
+    healed-node list (completion events for the wrong nodes)."""
+    c = FakeCluster()
+    mgr = ClusterUpgradeStateManager(
+        c, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(SlowHealthyProber(0.0))
+    vm = mgr.validation_manager
+    vm.pending_rollback["pool-a"] = "eviction incomplete"
+    vm._rollback_last_attempt["pool-a"] = time.monotonic()
+    vm._rollback_failed_nodes["pool-a"] = ["node-1"]
+    vm.clear_pending_rollback("pool-a")
+    assert "pool-a" not in vm.pending_rollback
+    assert "pool-a" not in vm._rollback_last_attempt
+    assert "pool-a" not in vm._rollback_failed_nodes
+
+
+def test_rollback_completion_events_only_for_failed_nodes():
+    """When a blocked eviction finally completes, the closing Normal
+    event goes to the nodes that actually had a Warning to close out —
+    not the whole group (clean-drain nodes never warned; a completion
+    there is unpaired noise)."""
+    from tests.test_rollback_eviction import _timed_out_validating_slice
+
+    c, fx, mgr, policy, nodes, wl, recorder = _timed_out_validating_slice()
+
+    def _tick():
+        mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+        assert mgr.wait_for_async_work(30.0)
+
+    _tick()  # validation timeout -> FAILED + blocked eviction on nodes[0]
+    assert mgr.validation_manager.pending_rollback
+    c.set_eviction_blocked(wl.namespace, wl.name, blocked=False)
+    _tick()  # retry completes
+    assert not mgr.validation_manager.pending_rollback
+    completions = [
+        e
+        for e in recorder.events
+        if e.event_type == "Normal"
+        and "Rollback eviction completed" in e.message
+    ]
+    assert {e.object_name for e in completions} == {nodes[0].name}

@@ -34,7 +34,14 @@ from k8s_operator_libs_tpu.api import (
     SliceHealthGateSpec,
     TPUUpgradePolicySpec,
 )
-from k8s_operator_libs_tpu.k8s import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s import (
+    CircuitBreaker,
+    FakeCluster,
+    FaultSchedule,
+    NotFoundError,
+    ResilientClient,
+    RetryPolicy,
+)
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
     ProbeResult,
@@ -122,8 +129,46 @@ def _build_scenario(seed: int):
             "healed": False,
         }
 
+    # API fault plan: most seeds also run a bounded throttle/5xx schedule
+    # against the store with the resilient client in front of the engine
+    # (the chaos tier's fault-tolerance layer, here under random shapes).
+    # Rules stay scoped to patch_node/list_nodes so the test's own
+    # invariant reads (get_node) observe the store fault-free, and every
+    # rule carries a max_hits budget so the faults deterministically
+    # clear well inside the tick limit.
+    engine_client = cluster
+    if rng.random() < 0.7:
+        schedule = FaultSchedule(seed=seed)
+        if rng.random() < 0.8:
+            schedule.throttle(
+                "patch_node",
+                retry_after_s=0.001,
+                probability=0.3,
+                max_hits=rng.randint(2, 10),
+            )
+        if rng.random() < 0.8:
+            schedule.server_error(
+                "list_nodes",
+                status=rng.choice([500, 503]),
+                probability=0.2,
+                max_hits=rng.randint(1, 6),
+            )
+        cluster.fault_schedule = schedule
+        engine_client = ResilientClient(
+            cluster,
+            retry_policy=RetryPolicy(
+                max_attempts=2,
+                base_backoff_s=0.001,
+                max_backoff_s=0.005,
+                jitter=0.0,
+            ),
+            breaker=CircuitBreaker(
+                failure_threshold=3, reset_timeout_s=0.02
+            ),
+        )
+
     mgr = ClusterUpgradeStateManager(
-        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+        engine_client, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
     ).with_validation_enabled(_FlakyGate(rng.randint(0, 2)))
     mgr.recovery_probe_backoff_s = 0.0
     mgr.validation_manager.rollback_drain_timeout_s = 0.2
@@ -164,12 +209,18 @@ def test_random_scenarios_hold_invariants(seed):
     for tick in range(300):
         try:
             state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
         except NotFoundError:
             # Cache lag on fresh objects — requeue like a reconciler.
             time.sleep(0.05)
             continue
-        mgr.apply_state(state, policy)
-        assert mgr.wait_for_async_work(30.0)
+        except RuntimeError:
+            # An injected API fault outlived the client's retries (or
+            # the breaker is open): requeue.  Invariants are still
+            # checked below — the store itself is always readable.
+            pass
+        finally:
+            assert mgr.wait_for_async_work(30.0)
 
         down = unavailable_slices()
         max_unavail_seen = max(max_unavail_seen, len(down))

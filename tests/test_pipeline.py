@@ -182,16 +182,20 @@ def test_pipeline_validation_timeout_recordons():
     # the slice the gate explicitly failed.
     for _ in range(3):
         mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+        assert mgr.wait_for_async_work(10.0)
         for n in nodes:
             assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
             assert c.get_node(n.name, cached=False).spec.unschedulable
     # Once the gate passes (slice genuinely healed), recovery proceeds.
     # (Recovery probes are rate-limited after a rejection; drop the
-    # backoff so the healed verdict is observed on the next pass.)
+    # backoff so the healed verdict is observed on the next pass.  The
+    # probe itself runs off-thread: wait for it between passes so the
+    # cached verdict is there for the following reconcile to consume.)
     mgr.validation_manager.prober = SlowProber(ticks=0)
     mgr.recovery_probe_backoff_s = 0.0
-    for _ in range(3):
+    for _ in range(4):
         mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+        assert mgr.wait_for_async_work(10.0)
     for n in nodes:
         assert state_of(c, KEYS, n.name) == UpgradeState.DONE.value
         assert not c.get_node(n.name, cached=False).spec.unschedulable

@@ -739,14 +739,18 @@ class TestPodRestartToDone:
         mgr = make_manager(c).with_validation_enabled(prober)
         for _ in range(5):
             mgr.apply_state(build(mgr), auto_policy())
+            assert mgr.wait_for_async_work(10.0)
         assert prober.calls == 1  # throttled: one probe, not five
         assert state_of(c, KEYS, n.name) == UpgradeState.FAILED.value
         # Backoff expiry -> re-probe; healthy verdict recovers the group
-        # and clears the cached rejection.
+        # and clears the cached rejection.  The probe runs off-thread, so
+        # one pass schedules it and the next consumes the cached verdict.
         mgr.recovery_probe_backoff_s = 0.0
         prober.healthy = True
         mgr.apply_state(build(mgr), auto_policy())
+        assert mgr.wait_for_async_work(10.0)
         assert prober.calls == 2
+        mgr.apply_state(build(mgr), auto_policy())
         assert (
             state_of(c, KEYS, n.name)
             == UpgradeState.UNCORDON_REQUIRED.value
