@@ -11,8 +11,9 @@ IMAGE ?= tpu-operator-libs
 TAG ?= latest
 BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
-.PHONY: all test test-fast lint typecheck cov-report bench graft-check \
-	clean generate generate-check docker-build docker-push .build-image
+.PHONY: all test test-fast chaos lint typecheck cov-report bench \
+	graft-check clean generate generate-check docker-build docker-push \
+	.build-image
 
 all: lint test
 
@@ -38,6 +39,16 @@ test-fast:
 		--ignore=tests/test_canary.py \
 		--ignore=tests/test_ring_attention.py \
 		--ignore=tests/test_chaos.py
+
+# Just the fault-injection tiers (chaos + seeded fuzz + node faults):
+# full rolls through API fault schedules, mid-roll hardware loss, slice
+# quarantine, and the eviction ladder.  PYTHONHASHSEED pins the one
+# remaining source of cross-run variation (set ordering); the fuzz
+# scenarios themselves are already seed-parameterized.
+chaos:
+	PYTHONHASHSEED=0 $(PYTHON) -m pytest -q \
+		tests/test_chaos.py tests/test_fuzz_invariants.py \
+		tests/test_node_faults.py
 
 # The in-repo linter (tools/lint.py: syntax, unused imports, undefined
 # names, bare excepts, mutable defaults) is the hard gate and always

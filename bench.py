@@ -66,8 +66,10 @@ sys.path.insert(0, os.path.join(_ROOT, "tests"))
 from k8s_operator_libs_tpu.bench_io import emit  # noqa: E402
 from k8s_operator_libs_tpu.api import (  # noqa: E402
     DrainSpec,
+    EvictionEscalationSpec,
     IntOrString,
     SliceHealthGateSpec,
+    SliceQuarantineSpec,
     TPUUpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.health import (  # noqa: E402
@@ -830,6 +832,26 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
     # Recovery probes are rate-limited after a rejection; a short backoff
     # keeps the recovered-timeline honest without hammering the battery.
     harness.mgr.recovery_probe_backoff_s = 5.0
+    # Data-plane stages riding the same roll: pool-2 loses a host to
+    # NotReady mid-flight (must quarantine, release its budget, rejoin
+    # after a 1 s dwell and complete), and one host of pool-3 carries a
+    # workload pod stuck in Terminating behind a finalizer (the eviction
+    # ladder must clear it instead of failing the drain).
+    harness.policy.slice_quarantine = SliceQuarantineSpec(
+        enable=True, ready_dwell_second=1
+    )
+    harness.policy.drain_spec.eviction_escalation = EvictionEscalationSpec(
+        enable=True,
+        evict_timeout_second=2,
+        delete_timeout_second=2,
+        allow_force_delete=True,
+    )
+    stuck_pod = harness.fx.workload_pod(
+        harness.slices[3][0], name="bench-stuck-finalizer"
+    )
+    harness.cluster.set_pod_finalizers(
+        stuck_pod.namespace, stuck_pod.name, ["bench/stuck"]
+    )
     harness.sweep_agents_once()
 
     # Victim: second host of pool-1.  The kill fires the first time
@@ -845,7 +867,35 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
     }
     timeline: dict = {}
 
+    q_victim = harness.slices[2][1].name
+
     def on_tick(states, t) -> None:
+        # Quarantine stage (pool-2), independent of pool-1's timeline.
+        s2 = states.get(harness.slices[2][0].name, "")
+        if "t_node_down" not in timeline:
+            if s2 in active_pre_validation:
+                harness.cluster.set_node_ready(q_victim, False)
+                timeline["t_node_down"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: node {q_victim} "
+                    f"NotReady (pool-2, state {s2})"
+                )
+        elif "t_quarantined" not in timeline:
+            if s2 == "quarantined":
+                timeline["t_quarantined"] = round(t, 2)
+                # The hardware comes back; the dwell clock starts.
+                harness.cluster.set_node_ready(q_victim, True)
+                log(
+                    f"  t={t:7.2f}s fail-inject: pool-2 quarantined; "
+                    f"{q_victim} Ready again (1 s dwell)"
+                )
+        elif "t_rejoined" not in timeline:
+            if s2 and s2 != "quarantined":
+                timeline["t_rejoined"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: pool-2 rejoined "
+                    f"(resumed {s2})"
+                )
         s1 = states.get(harness.slices[1][0].name, "")
         if "t_agent_killed" not in timeline:
             if s1 in active_pre_validation:
@@ -896,11 +946,21 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
         if "t_failed" in timeline and "t_validation_start" in timeline
         else None
     )
+    try:
+        harness.cluster.get_pod(stuck_pod.namespace, stuck_pod.name)
+        stuck_pod_cleared = False
+    except NotFoundError:
+        stuck_pod_cleared = True
     return {
         "complete": result["complete"],
         "wall_s": result["wall_s"],
         "victim": victim,
         "victim_slice": "pool-1",
+        "quarantine_victim": q_victim,
+        "quarantines": harness.mgr.quarantines_total,
+        "rejoins": harness.mgr.rejoins_total,
+        "escalations": harness.mgr.escalation_stats.snapshot(),
+        "stuck_pod_cleared": stuck_pod_cleared,
         "validation_timeout_s": FAILINJ_VALIDATION_TIMEOUT_S,
         "stuck_threshold_s": FAILINJ_STUCK_THRESHOLD_S,
         "timeline": timeline,
@@ -1165,8 +1225,10 @@ def main() -> None:
     log(
         f"failure injection: failed_within={failinj['failed_within_s']}s "
         f"recovered={failinj['recovered']} stuck_events_naming_victim="
-        f"{failinj['stuck_events_naming_victim']} complete="
-        f"{failinj['complete']}"
+        f"{failinj['stuck_events_naming_victim']} quarantines="
+        f"{failinj['quarantines']} rejoins={failinj['rejoins']} "
+        f"escalations={failinj['escalations']} stuck_pod_cleared="
+        f"{failinj['stuck_pod_cleared']} complete={failinj['complete']}"
     )
 
     # -- device-sustained canary throughput ----------------------------------
@@ -1289,6 +1351,12 @@ def main() -> None:
         "failinj_failed_within_s": failinj["failed_within_s"],
         "failinj_recovered": failinj["recovered"],
         "failinj_stuck_events": failinj["stuck_events_naming_victim"],
+        "failinj_quarantines": failinj["quarantines"],
+        "failinj_rejoins": failinj["rejoins"],
+        "failinj_force_deletes": failinj["escalations"].get(
+            "force_delete", 0
+        ),
+        "failinj_stuck_pod_cleared": failinj["stuck_pod_cleared"],
         "mxu_tflops": _num(mxu.get("tflops"), 1),
         "mxu_mfu": _num(mxu.get("mfu"), 3),
         "hbm_gbps": _num(hbm.get("gbps"), 1),

@@ -3,9 +3,11 @@
 from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
     DrainSpec,
     DriverUpgradePolicySpec,
+    EvictionEscalationSpec,
     IntOrString,
     PodDeletionSpec,
     SliceHealthGateSpec,
+    SliceQuarantineSpec,
     SliceTopologySpec,
     TPUUpgradePolicySpec,
     WaitForCompletionSpec,

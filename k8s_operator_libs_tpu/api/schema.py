@@ -68,6 +68,9 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
         "minimum": 0.0,
         "maximum": 1.0,
     },
+    ("EvictionEscalationSpec", "evict_timeout_second"): {"minimum": 0},
+    ("EvictionEscalationSpec", "delete_timeout_second"): {"minimum": 0},
+    ("SliceQuarantineSpec", "ready_dwell_second"): {"minimum": 0},
 }
 
 

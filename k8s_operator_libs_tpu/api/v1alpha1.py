@@ -86,6 +86,9 @@ _JSON_NAME_OVERRIDES = {
     # Reference upgrade_spec.go:63,77,104: TimeoutSecond -> "timeoutSeconds".
     "timeout_second": "timeoutSeconds",
     "stuck_threshold_second": "stuckThresholdSeconds",
+    "evict_timeout_second": "evictTimeoutSeconds",
+    "delete_timeout_second": "deleteTimeoutSeconds",
+    "ready_dwell_second": "readyDwellSeconds",
 }
 
 
@@ -170,18 +173,49 @@ class PodDeletionSpec(_SpecBase):
 
 
 @dataclass
+class EvictionEscalationSpec(_SpecBase):
+    """Eviction escalation ladder (new; no reference analogue).
+
+    When a drain's eviction stalls — a PodDisruptionBudget that never
+    releases, or a pod held Terminating by a finalizer — the ladder
+    escalates evict → delete → force-delete (grace 0), each rung gated
+    by its own timeout.  Disabled by default; the force rung is
+    separately opt-in because force-deleting a pod whose kubelet is
+    still alive can leave containers running on the ICI domain.
+    """
+
+    enable: bool = False
+    # Seconds a pod may resist eviction before escalating to delete.
+    evict_timeout_second: int = 300
+    # Seconds a delete may dangle (stuck Terminating) before force.
+    delete_timeout_second: int = 300
+    # Allow the final rung: delete with gracePeriodSeconds=0.
+    allow_force_delete: bool = False
+
+    def validate(self) -> None:
+        if self.evict_timeout_second < 0 or self.delete_timeout_second < 0:
+            raise ValidationError(
+                "evictionEscalation timeouts must be >= 0"
+            )
+
+
+@dataclass
 class DrainSpec(_SpecBase):
-    """Node drain configuration (upgrade_spec.go:85-110)."""
+    """Node drain configuration (upgrade_spec.go:85-110), extended with
+    the opt-in eviction escalation ladder."""
 
     enable: bool = False
     force: bool = False
     pod_selector: str = ""
     timeout_second: int = 300
     delete_empty_dir: bool = False
+    eviction_escalation: Optional[EvictionEscalationSpec] = None
 
     def validate(self) -> None:
         if self.timeout_second < 0:
             raise ValidationError("drain.timeoutSeconds must be >= 0")
+        if self.eviction_escalation is not None:
+            self.eviction_escalation.validate()
 
 
 @dataclass
@@ -279,6 +313,31 @@ class SliceHealthGateSpec(_SpecBase):
 
 
 @dataclass
+class SliceQuarantineSpec(_SpecBase):
+    """Data-plane fault handling for in-flight slices (new component).
+
+    When a member of an in-flight slice goes NotReady or vanishes, the
+    whole slice parks in the ``quarantined`` state: it stops charging
+    the unavailability budget and holds its position until every host
+    stays Ready for ``ready_dwell_second`` (hysteresis — a flapping
+    kubelet must not thrash cordon/uncordon), then resumes the exact
+    state it left.  Enabled by default: parking a slice on dead
+    hardware is strictly safer than letting it pin budget forever.
+    """
+
+    enable: bool = True
+    # Seconds every host must stay Ready before the slice rejoins the
+    # roll.  The dwell clock restarts on any readiness flap.
+    ready_dwell_second: int = 300
+
+    def validate(self) -> None:
+        if self.ready_dwell_second < 0:
+            raise ValidationError(
+                "sliceQuarantine.readyDwellSeconds must be >= 0"
+            )
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -319,6 +378,11 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # per-host probe agents already vouch for basic chip health, and
     # required to meet a <2 min budget on multi-slice pools.
     pipeline_validation: bool = False
+    # Data-plane fault handling: quarantine in-flight slices that lose a
+    # host instead of charging the budget while hardware is dead.
+    slice_quarantine: Optional[SliceQuarantineSpec] = field(
+        default_factory=SliceQuarantineSpec
+    )
 
     def validate(self) -> None:
         super().validate()
@@ -333,6 +397,8 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.topology.validate()
         if self.health_gate is not None:
             self.health_gate.validate()
+        if self.slice_quarantine is not None:
+            self.slice_quarantine.validate()
 
 
 # Nested-type registry for from_dict (maps (class, field) -> spec type).
@@ -340,9 +406,11 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("DriverUpgradePolicySpec", "pod_deletion"): PodDeletionSpec,
     ("DriverUpgradePolicySpec", "wait_for_completion"): WaitForCompletionSpec,
     ("DriverUpgradePolicySpec", "drain_spec"): DrainSpec,
+    ("DrainSpec", "eviction_escalation"): EvictionEscalationSpec,
     ("TPUUpgradePolicySpec", "pod_deletion"): PodDeletionSpec,
     ("TPUUpgradePolicySpec", "wait_for_completion"): WaitForCompletionSpec,
     ("TPUUpgradePolicySpec", "drain_spec"): DrainSpec,
     ("TPUUpgradePolicySpec", "topology"): SliceTopologySpec,
     ("TPUUpgradePolicySpec", "health_gate"): SliceHealthGateSpec,
+    ("TPUUpgradePolicySpec", "slice_quarantine"): SliceQuarantineSpec,
 }

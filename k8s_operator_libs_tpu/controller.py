@@ -44,6 +44,7 @@ from k8s_operator_libs_tpu.driver.daemonset import (
 from k8s_operator_libs_tpu.health import NodeReportProber
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.retry import CircuitOpenError
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.metrics import (
     MetricsRegistry,
     MetricsServer,
@@ -422,6 +423,9 @@ class UpgradeController:
                 "currentUnavailableNodes": m.get_current_unavailable_nodes(
                     state
                 ),
+                "quarantinedSlices": len(
+                    state.groups_in(UpgradeState.QUARANTINED)
+                ),
                 "apiCircuitOpenEndpoints": self._open_circuit_count(),
             }
             status["conditions"] = self._conditions(
@@ -449,11 +453,25 @@ class UpgradeController:
         in_progress = status.get("upgradesInProgress", 0)
         pending = status.get("upgradesPending", 0)
         failed = status.get("upgradesFailed", 0)
+        quarantined = status.get("quarantinedSlices", 0)
         open_circuits = status.get("apiCircuitOpenEndpoints", 0)
         in_flight = in_progress + pending
         if failed:
             degraded_reason = "SlicesFailed"
             degraded_msg = f"{failed} node(s) in upgrade-failed"
+            if quarantined:
+                degraded_msg += f"; {quarantined} slice(s) quarantined"
+            if open_circuits:
+                degraded_msg += (
+                    f"; {open_circuits} API endpoint(s) circuit-open"
+                )
+        elif quarantined:
+            degraded_reason = "SliceQuarantined"
+            degraded_msg = (
+                f"{quarantined} slice(s) quarantined after mid-roll "
+                "hardware loss; each resumes once its hosts stay Ready "
+                "past the dwell window"
+            )
             if open_circuits:
                 degraded_msg += (
                     f"; {open_circuits} API endpoint(s) circuit-open"
@@ -478,18 +496,20 @@ class UpgradeController:
             ),
             (
                 "Degraded",
-                failed > 0 or open_circuits > 0,
+                failed > 0 or quarantined > 0 or open_circuits > 0,
                 degraded_reason,
                 degraded_msg,
             ),
             (
                 "Complete",
-                in_flight == 0 and failed == 0,
+                in_flight == 0 and failed == 0 and quarantined == 0,
                 (
                     "AllDone"
-                    if in_flight == 0 and failed == 0
+                    if in_flight == 0 and failed == 0 and quarantined == 0
                     else "Failures"
                     if failed
+                    else "SlicesQuarantined"
+                    if quarantined
                     else "InProgress"
                 ),
                 f"{status.get('upgradesDone', 0)}/"

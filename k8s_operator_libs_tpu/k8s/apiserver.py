@@ -418,6 +418,11 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if method == "PATCH":
                 return self._patch_node(name)
+            if method == "DELETE":
+                self.store.delete_node(name)
+                return self._send(
+                    200, _status_body(200, "Success", "deleted")
+                )
         # /api/v1/pods and /api/v1/namespaces/{ns}/pods[/{name}[/eviction]]
         if parts[:2] == ["api", "v1"]:
             # /api/v1/events — cluster-wide event list.
@@ -489,7 +494,14 @@ class _Handler(BaseHTTPRequestHandler):
                         200, pod_to_json(self.store.get_pod(ns, name))
                     )
                 if len(parts) == 6 and method == "DELETE":
-                    self.store.delete_pod(ns, name)
+                    grace = query.get("gracePeriodSeconds")
+                    self.store.delete_pod(
+                        ns,
+                        name,
+                        grace_period_seconds=(
+                            int(grace) if grace is not None else None
+                        ),
+                    )
                     return self._send(
                         200, _status_body(200, "Success", "deleted")
                     )

@@ -464,6 +464,11 @@ class FakeCluster:
             self.fault_injector(verb)
         if self.fault_schedule is not None:
             self.fault_schedule.raise_for(verb)
+            # Data-plane faults (node NotReady/flap/delete, stuck pods,
+            # crash loops) mutate CLUSTER STATE rather than failing this
+            # call; API traffic is their clock, so both tiers (fake verbs
+            # and wire requests routed through this store) tick them.
+            self._apply_data_plane_faults(verb)
 
     def on_pod_deleted(self, hook: Callable[[Pod], None]) -> None:
         """Register a hook fired after a pod is deleted/evicted (lets tests
@@ -562,6 +567,92 @@ class FakeCluster:
                 )
             self._nodes.put(name, node)
             return deep_copy(node)
+
+    def delete_node(self, name: str) -> None:
+        """Delete a node, garbage-collecting its pods the way the pod GC
+        does for a vanished kubelet: force (finalizers cannot hold a pod
+        on hardware that no longer exists).  DaemonSet-owned pods also
+        decrement their owner's desiredNumberScheduled — the DS
+        controller's bookkeeping — so build_state's completeness guard
+        stays coherent after the loss."""
+        self._call("delete_node")
+        with self._lock:
+            if self._nodes.get_live(name) is None:
+                raise NotFoundError(f"node {name}")
+            self._delete_node_locked(name)
+
+    def _delete_node_locked(self, name: str) -> None:
+        doomed = [
+            p for p in self._pods.objs.values() if p.spec.node_name == name
+        ]
+        for pod in doomed:
+            for ref in pod.metadata.owner_references:
+                if ref.kind != "DaemonSet":
+                    continue
+                for ds in self._daemon_sets.objs.values():
+                    if ds.metadata.uid == ref.uid:
+                        ds.status.desired_number_scheduled = max(
+                            0, ds.status.desired_number_scheduled - 1
+                        )
+                        self._daemon_sets.put((ds.namespace, ds.name), ds)
+            key = self._pod_key(pod.namespace, pod.name)
+            pod.metadata.deletion_timestamp = time.time()
+            self._pods.delete(key)
+            self._eviction_blocked.discard(key)
+        self._nodes.delete(name)
+
+    # -- data-plane fault application ---------------------------------------
+
+    def _apply_data_plane_faults(self, verb: str) -> None:
+        """Apply any node/pod faults the schedule fires for this verb.
+        Mutations go through the internal locked paths (not the public
+        verbs), so applying a fault never re-enters fault evaluation."""
+        schedule = self.fault_schedule
+        if schedule is None:
+            return
+        for fault in schedule.decide_data_plane(verb):
+            with self._lock:
+                if fault.kind in ("node_down", "node_flap"):
+                    for name in list(self._nodes.objs):
+                        if fault.target in name:
+                            node = self._nodes.objs[name]
+                            ready = (
+                                not node.is_ready()
+                                if fault.kind == "node_flap"
+                                else False
+                            )
+                            self._set_node_ready_locked(node, ready)
+                elif fault.kind == "node_delete":
+                    for name in list(self._nodes.objs):
+                        if fault.target in name:
+                            self._delete_node_locked(name)
+                elif fault.kind == "pod_stick":
+                    for key in list(self._pods.objs):
+                        if fault.target in key[1]:
+                            pod = self._pods.objs[key]
+                            if not pod.metadata.finalizers:
+                                pod.metadata.finalizers.append(
+                                    "fault-injection/stuck-terminating"
+                                )
+                                self._pods.put(key, pod)
+                elif fault.kind == "pod_crashloop":
+                    for key in list(self._pods.objs):
+                        if fault.target in key[1]:
+                            pod = self._pods.objs[key]
+                            for cs in pod.status.container_statuses:
+                                cs.ready = False
+                                cs.restart_count += fault.amount
+                            self._pods.put(key, pod)
+
+    def _set_node_ready_locked(self, node: Node, ready: bool) -> None:
+        status = "True" if ready else "False"
+        for cond in node.status.conditions:
+            if cond.type == "Ready":
+                cond.status = status
+                break
+        else:
+            node.status.conditions.append(NodeCondition("Ready", status))
+        self._nodes.put(node.name, node)
 
     # -- paginated list (the client-go chunked-list contract) ---------------
 
@@ -696,9 +787,16 @@ class FakeCluster:
             self._pods.put(key, pod)
             return deep_copy(pod)
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(
+        self,
+        namespace: str,
+        name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
         self._call("delete_pod")
-        self._delete_pod_impl(namespace, name)
+        self._delete_pod_impl(
+            namespace, name, grace_period_seconds=grace_period_seconds
+        )
 
     def set_eviction_blocked(
         self, namespace: str, name: str, blocked: bool = True
@@ -726,16 +824,53 @@ class FakeCluster:
                 )
         self._delete_pod_impl(namespace, name)
 
-    def _delete_pod_impl(self, namespace: str, name: str) -> None:
+    def _delete_pod_impl(
+        self,
+        namespace: str,
+        name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
         with self._lock:
             key = self._pod_key(namespace, name)
             pod = self._pods.get_live(key)
             if pod is None:
                 raise NotFoundError(f"pod {namespace}/{name}")
+            if pod.metadata.finalizers and grace_period_seconds != 0:
+                # Finalizers hold a graceful delete in Terminating: the
+                # deletion timestamp lands, the pod stays served, and no
+                # deletion hooks fire until the finalizers are removed or
+                # the delete is re-issued with grace period 0.
+                if pod.metadata.deletion_timestamp is None:
+                    pod.metadata.deletion_timestamp = time.time()
+                self._pods.put(key, pod)
+                return
             pod.metadata.deletion_timestamp = time.time()
             self._pods.delete(key)
             self._eviction_blocked.discard(key)
             hooks = list(self._pod_deleted_hooks)
+        for hook in hooks:
+            hook(pod)
+
+    def set_pod_finalizers(
+        self, namespace: str, name: str, finalizers: list[str]
+    ) -> None:
+        """Test knob: replace a pod's finalizers.  Clearing the last
+        finalizer on a Terminating pod completes the held deletion (the
+        finalizer-controller behaviour the stuck-Terminating fault
+        models)."""
+        with self._lock:
+            key = self._pod_key(namespace, name)
+            pod = self._pods.get_live(key)
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name}")
+            pod.metadata.finalizers = list(finalizers)
+            if not pod.metadata.finalizers and pod.is_terminating():
+                self._pods.delete(key)
+                self._eviction_blocked.discard(key)
+                hooks = list(self._pod_deleted_hooks)
+            else:
+                self._pods.put(key, pod)
+                hooks = []
         for hook in hooks:
             hook(pod)
 

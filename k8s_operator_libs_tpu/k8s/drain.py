@@ -16,7 +16,9 @@ Behavioral analogue of ``k8s.io/kubectl/pkg/drain`` as the reference uses it
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import Counter
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -31,6 +33,72 @@ from k8s_operator_libs_tpu.k8s.objects import Node, Pod
 
 class DrainError(RuntimeError):
     pass
+
+
+# Ladder rungs, in escalation order.
+RUNG_EVICT = "evict"
+RUNG_DELETE = "delete"
+RUNG_FORCE_DELETE = "force_delete"
+
+
+@dataclass
+class EscalationConfig:
+    """Runtime knobs for the eviction escalation ladder.
+
+    Disabled by default: a drain then behaves exactly as kubectl's —
+    evict and wait, stalling forever on a PDB or a stuck finalizer until
+    the overall drain timeout.  Enabled, a pod that outlives a rung's
+    timeout escalates evict → delete (bypasses the PDB, honors
+    finalizers) → force-delete (grace 0, bypasses finalizers too).  The
+    force rung is separately opt-in: on a TPU slice it is only safe when
+    the kubelet is actually gone, since a force-deleted pod's containers
+    may still be running and holding the ICI domain.
+    """
+
+    enable: bool = False
+    evict_timeout_s: float = 30.0
+    delete_timeout_s: float = 30.0
+    allow_force_delete: bool = False
+
+
+class EscalationStats:
+    """Thread-safe per-rung counters.
+
+    DrainHelper instances are per-call ephemerals; the upgrade manager
+    owns one stats object and threads it through every construction
+    site, so counters survive across drains and surface in metrics.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._rungs: Counter[str] = Counter()
+
+    def record(self, rung: str) -> None:
+        with self._mu:
+            self._rungs[rung] += 1
+
+    def get(self, rung: str) -> int:
+        with self._mu:
+            return self._rungs[rung]
+
+    def snapshot(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._rungs)
+
+
+def escalation_from_spec(spec) -> Optional[EscalationConfig]:
+    """Build an :class:`EscalationConfig` from an EvictionEscalationSpec.
+
+    Duck-typed (attribute access) so this layer stays independent of the
+    api package; ``None`` in, ``None`` out."""
+    if spec is None:
+        return None
+    return EscalationConfig(
+        enable=bool(spec.enable),
+        evict_timeout_s=float(spec.evict_timeout_second),
+        delete_timeout_s=float(spec.delete_timeout_second),
+        allow_force_delete=bool(spec.allow_force_delete),
+    )
 
 
 # An additional filter returns (delete: bool, skip_reason: str | None).
@@ -66,6 +134,8 @@ class DrainHelper:
         on_pod_deleted: Optional[Callable[[Pod, bool], None]] = None,
         poll_interval_s: float = 1.0,
         eviction_retry_interval_s: Optional[float] = None,
+        escalation: Optional[EscalationConfig] = None,
+        escalation_stats: Optional[EscalationStats] = None,
     ) -> None:
         self.client = client
         self.force = force
@@ -86,6 +156,8 @@ class DrainHelper:
             if eviction_retry_interval_s is not None
             else 5.0 * poll_interval_s
         )
+        self.escalation = escalation
+        self.escalation_stats = escalation_stats
 
     # -- cordon ------------------------------------------------------------
 
@@ -146,21 +218,67 @@ class DrainHelper:
         An eviction rejected by a PodDisruptionBudget (HTTP 429 →
         :class:`EvictionBlockedError`) is retried until the drain timeout,
         matching kubectl drain's behavior — a temporarily-blocked PDB must
-        stall the drain, not crash the reconcile."""
+        stall the drain, not crash the reconcile.
+
+        With an enabled :class:`EscalationConfig`, a pod that outlives a
+        rung's timeout climbs the ladder instead of stalling forever:
+        evict → delete (bypasses the PDB, honors finalizers) →
+        force-delete (grace 0, bypasses finalizers; only if
+        ``allow_force_delete``).  Rung clocks restart on escalation."""
         deadline = (
             time.monotonic() + self.timeout_s if self.timeout_s > 0 else None
         )
+        esc = self.escalation
         by_key = {(p.namespace, p.name): p for p in pods}
-        to_evict = set(by_key)
-        pending = set(by_key)
+        pending = set(by_key)  # pods not yet confirmed gone
+        issued = set()  # pods whose current rung's API call succeeded
+        now = time.monotonic()
+        rung = {key: RUNG_EVICT for key in by_key}
+        rung_since = {key: now for key in by_key}
+        if self.escalation_stats is not None:
+            for key in by_key:
+                self.escalation_stats.record(RUNG_EVICT)
         while True:
             backoff_s = 0.0
-            for key in sorted(to_evict):
+            # Escalate pods that outlived their rung's budget — whether
+            # the rung's call keeps failing (PDB 429s) or it succeeded
+            # but the pod never vanished (finalizer holds it
+            # Terminating): both need the next rung, so the clock runs
+            # from rung entry, not from call success.
+            if esc is not None and esc.enable:
+                now = time.monotonic()
+                for key in sorted(pending):
+                    overdue = now - rung_since[key]
+                    if (
+                        rung[key] == RUNG_EVICT
+                        and overdue > esc.evict_timeout_s
+                    ):
+                        rung[key] = RUNG_DELETE
+                    elif (
+                        rung[key] == RUNG_DELETE
+                        and esc.allow_force_delete
+                        and overdue > esc.delete_timeout_s
+                    ):
+                        rung[key] = RUNG_FORCE_DELETE
+                    else:
+                        continue
+                    rung_since[key] = now
+                    issued.discard(key)
+                    if self.escalation_stats is not None:
+                        self.escalation_stats.record(rung[key])
+            for key in sorted(pending - issued):
                 ns, name = key
                 try:
-                    self.client.evict_pod(ns, name)
+                    if rung[key] == RUNG_EVICT:
+                        self.client.evict_pod(ns, name)
+                    elif rung[key] == RUNG_DELETE:
+                        self.client.delete_pod(ns, name)
+                    else:
+                        self.client.delete_pod(
+                            ns, name, grace_period_seconds=0
+                        )
                 except NotFoundError:
-                    to_evict.discard(key)  # already gone
+                    issued.add(key)  # already gone
                     continue
                 except EvictionBlockedError:
                     # PDB: retry next round, but back off — re-POSTing a
@@ -176,12 +294,12 @@ class DrainHelper:
                         backoff_s, e.retry_after_s, self.poll_interval_s
                     )
                     break
-                to_evict.discard(key)
+                issued.add(key)
                 if self.on_pod_deleted is not None:
                     self.on_pod_deleted(by_key[key], True)
             # Wait for evicted pods to vanish (kubectl waits for deletion).
             gone = set()
-            for ns, name in pending - to_evict:
+            for ns, name in pending & issued:
                 try:
                     self.client.get_pod(ns, name)
                 except NotFoundError:
@@ -192,8 +310,8 @@ class DrainHelper:
             if not pending:
                 return
             if deadline is not None and time.monotonic() > deadline:
-                blocked = sorted(to_evict)
-                waiting = sorted(pending - to_evict)
+                blocked = sorted(pending - issued)
+                waiting = sorted(pending & issued)
                 detail = []
                 if blocked:
                     detail.append(f"evictions blocked by PDB: {blocked}")

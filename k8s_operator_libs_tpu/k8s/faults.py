@@ -40,6 +40,21 @@ __all__ = ["Fault", "FaultRule", "FaultSchedule"]
 # watch_drop rule's budget is never consumed by regular verbs.
 _KINDS = ("throttle", "error", "reset", "timeout", "conflict", "watch_drop")
 
+# Data-plane fault kinds mutate CLUSTER STATE instead of failing the
+# matching call: the call succeeds, and as a side effect a node loses
+# readiness / flaps / vanishes, or a pod gets stuck Terminating / starts
+# crash-looping.  API traffic is their clock — the store applies them
+# after each successful verb (FakeCluster._apply_data_plane_faults), so
+# both the fake tier and the wire tier (whose handlers route through the
+# same store) tick them.  ``decide``/``raise_for`` skip them entirely.
+_DATA_PLANE_KINDS = (
+    "node_down",
+    "node_flap",
+    "node_delete",
+    "pod_stick",
+    "pod_crashloop",
+)
+
 
 @dataclass
 class Fault:
@@ -50,6 +65,11 @@ class Fault:
     retry_after_s: float = 1.0
     delay_s: float = 0.0
     message: str = "injected fault"
+    # Data-plane kinds only: which objects to hit (substring of the node
+    # or pod name; empty hits everything) and how hard (restart-count
+    # increment for pod_crashloop).
+    target: str = ""
+    amount: int = 1
 
 
 @dataclass
@@ -73,13 +93,16 @@ class FaultRule:
     skip: int = 0
     max_hits: Optional[int] = None
     message: str = ""
+    target: str = ""
+    amount: int = 1
     _seen: int = field(default=0, repr=False)
     _hits: int = field(default=0, repr=False)
 
     def __post_init__(self) -> None:
-        if self.kind not in _KINDS:
+        if self.kind not in _KINDS and self.kind not in _DATA_PLANE_KINDS:
             raise ValueError(
-                f"unknown fault kind {self.kind!r}; expected one of {_KINDS}"
+                f"unknown fault kind {self.kind!r}; expected one of "
+                f"{_KINDS + _DATA_PLANE_KINDS}"
             )
 
     def _matches(self, verb: str) -> bool:
@@ -104,6 +127,8 @@ class FaultRule:
             delay_s=self.delay_s,
             message=self.message
             or f"injected {self.kind} for {verb!r} (hit {self._hits})",
+            target=self.target,
+            amount=self.amount,
         )
 
 
@@ -180,6 +205,54 @@ class FaultSchedule:
         """Server closes a watch stream mid-flight (client must re-list)."""
         return self.add(FaultRule(match=match, kind="watch_drop", **kw))
 
+    # -- data-plane faults (mutate cluster state, never fail the call) -----
+
+    def node_down(
+        self, target: str, match: str = "", **kw
+    ) -> "FaultSchedule":
+        """Nodes whose name contains ``target`` go NotReady."""
+        return self.add(
+            FaultRule(match=match, kind="node_down", target=target, **kw)
+        )
+
+    def node_flap(
+        self, target: str, match: str = "", **kw
+    ) -> "FaultSchedule":
+        """Toggle readiness of matching nodes on each hit — the
+        flapping-kubelet shape the quarantine hysteresis exists for."""
+        return self.add(
+            FaultRule(match=match, kind="node_flap", target=target, **kw)
+        )
+
+    def node_delete(
+        self, target: str, match: str = "", **kw
+    ) -> "FaultSchedule":
+        """Delete matching nodes outright (hardware reclaimed mid-roll)."""
+        return self.add(
+            FaultRule(match=match, kind="node_delete", target=target, **kw)
+        )
+
+    def pod_stick(
+        self, target: str, match: str = "", **kw
+    ) -> "FaultSchedule":
+        """Add a finalizer to matching pods so deletes park them in
+        Terminating (what the eviction escalation ladder must clear)."""
+        return self.add(
+            FaultRule(match=match, kind="pod_stick", target=target, **kw)
+        )
+
+    def pod_crashloop(
+        self, target: str, match: str = "", amount: int = 1, **kw
+    ) -> "FaultSchedule":
+        """Matching pods lose container readiness and gain ``amount``
+        restarts per hit (CrashLoopBackOff shape)."""
+        return self.add(
+            FaultRule(
+                match=match, kind="pod_crashloop", target=target,
+                amount=amount, **kw,
+            )
+        )
+
     def clear(self) -> None:
         """Drop every rule — 'the faults clear'."""
         with self._lock:
@@ -197,6 +270,8 @@ class FaultSchedule:
             for rule in self._rules:
                 if rule.kind == "watch_drop":
                     continue  # stream loops consult decide_watch_drop
+                if rule.kind in _DATA_PLANE_KINDS:
+                    continue  # the store consults decide_data_plane
                 fault = rule._decide_locked(verb, self._rng)
                 if fault is not None:
                     break
@@ -205,6 +280,27 @@ class FaultSchedule:
         if fault is not None and self.on_fault is not None:
             self.on_fault(verb, fault)
         return fault
+
+    def decide_data_plane(self, verb: str) -> list[Fault]:
+        """Store entry point: ALL firing data-plane faults for this call.
+
+        Unlike :meth:`decide`, every matching rule fires (a node can go
+        down while another pod sticks); unary/watch rules are never
+        consulted, so their budgets are untouched."""
+        fired: list[Fault] = []
+        with self._lock:
+            for rule in self._rules:
+                if rule.kind not in _DATA_PLANE_KINDS:
+                    continue
+                fault = rule._decide_locked(verb, self._rng)
+                if fault is not None:
+                    fired.append(fault)
+            if fired:
+                self.hits[verb] += len(fired)
+        if self.on_fault is not None:
+            for fault in fired:
+                self.on_fault(verb, fault)
+        return fired
 
     def decide_watch_drop(self, verb: str = "watch") -> Optional[Fault]:
         """Streaming-loop entry point: consult ONLY ``watch_drop`` rules.

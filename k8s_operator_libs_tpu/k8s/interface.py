@@ -79,7 +79,15 @@ class KubeClient(Protocol):
     ) -> list[Pod]:
         ...
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(
+        self,
+        namespace: str,
+        name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """Delete a pod.  ``grace_period_seconds=0`` force-deletes:
+        finalizers are bypassed and the object is removed immediately —
+        the last rung of the eviction escalation ladder."""
         ...
 
     def evict_pod(self, namespace: str, name: str) -> None:

@@ -40,6 +40,11 @@ class ObjectMeta:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     owner_references: list[OwnerReference] = field(default_factory=list)
+    # Non-empty finalizers hold a deleted object in Terminating (deletion
+    # timestamp set, object still served) until they are removed or the
+    # delete is forced with grace period 0 — the stuck-Terminating pod
+    # shape the eviction escalation ladder exists to clear.
+    finalizers: list[str] = field(default_factory=list)
     deletion_timestamp: Optional[float] = None
     creation_timestamp: float = field(default_factory=time.time)
     resource_version: int = 1

@@ -893,10 +893,16 @@ class RestClient:
         out = self._request("GET", path, query)
         return [pod_from_json(i) for i in out.get("items", [])]
 
-    def delete_pod(self, namespace: str, name: str) -> None:
-        self._request(
-            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}"
-        )
+    def delete_pod(
+        self,
+        namespace: str,
+        name: str,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        path = f"/api/v1/namespaces/{namespace}/pods/{name}"
+        if grace_period_seconds is not None:
+            path += f"?gracePeriodSeconds={grace_period_seconds}"
+        self._request("DELETE", path)
 
     def evict_pod(self, namespace: str, name: str) -> None:
         """policy/v1 Eviction — what kubectl drain actually calls

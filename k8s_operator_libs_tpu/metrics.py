@@ -139,6 +139,25 @@ class UpgradeMetrics:
             "state",
         )
         r.describe(
+            "slices_quarantined",
+            "Groups currently parked in quarantined (mid-roll hardware "
+            "loss; holds no unavailability budget)",
+        )
+        r.describe(
+            "slice_quarantines_total",
+            "Slice quarantine transitions since controller start",
+        )
+        r.describe(
+            "slice_rejoins_total",
+            "Slice rejoin-after-quarantine transitions since controller "
+            "start",
+        )
+        r.describe(
+            "eviction_escalations_total",
+            "Eviction-ladder rung entries since controller start",
+            "rung",
+        )
+        r.describe(
             "api_circuit_open_endpoints",
             "API endpoints whose circuit breaker is currently open "
             "(>0 = reconcile degraded)",
@@ -172,6 +191,20 @@ class UpgradeMetrics:
         r.set("upgrades_pending", manager.get_upgrades_pending(state))
         r.set("reconcile_duration_seconds", duration_s)
         r.inc("reconcile_total")
+        # Data-plane fault-tolerance surface (absent on injected fakes).
+        r.set(
+            "slices_quarantined",
+            len(state.groups_in(UpgradeState.QUARANTINED)),
+        )
+        r.set(
+            "slice_quarantines_total",
+            getattr(manager, "quarantines_total", 0),
+        )
+        r.set("slice_rejoins_total", getattr(manager, "rejoins_total", 0))
+        esc_stats = getattr(manager, "escalation_stats", None)
+        if esc_stats is not None and hasattr(esc_stats, "snapshot"):
+            for rung, count in sorted(esc_stats.snapshot().items()):
+                r.set("eviction_escalations_total", count, rung=rung)
         # Client resilience surface (present on RestClient and
         # ResilientClient; absent on a bare FakeCluster).
         client = getattr(manager, "client", None)

@@ -19,6 +19,8 @@ import json as _json
 from typing import Optional
 
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
+from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import node_ready
 from k8s_operator_libs_tpu.upgrade.upgrade_state import (
     BuildStateError,
     ClusterUpgradeStateManager,
@@ -86,7 +88,7 @@ def gather(
         unavailable = sum(
             1
             for m in group.members
-            if m.node.spec.unschedulable or not m.node.is_ready()
+            if m.node.spec.unschedulable or not node_ready(m.node)
         )
         groups.append(
             {
@@ -94,6 +96,7 @@ def gather(
                 "state": effective,
                 "hosts": group.size(),
                 "unavailable": unavailable,
+                "quarantined": effective == UpgradeState.QUARANTINED.value,
                 "accelerator": (
                     group.slice_info.accelerator if group.slice_info else ""
                 ),
@@ -115,6 +118,9 @@ def gather(
         "upgradesDone": mgr.get_upgrades_done(state),
         "upgradesFailed": mgr.get_upgrades_failed(state),
         "upgradesPending": mgr.get_upgrades_pending(state),
+        "slicesQuarantined": len(
+            state.groups_in(UpgradeState.QUARANTINED)
+        ),
         "groups": groups,
     }
     if policy_section is not None:
@@ -188,7 +194,8 @@ def render(status: dict) -> str:
         f"nodes: {status['totalManagedNodes']} in {status['totalManagedGroups']} "
         f"group(s) | in-progress {status['upgradesInProgress']} "
         f"pending {status['upgradesPending']} done {status['upgradesDone']} "
-        f"failed {status['upgradesFailed']}",
+        f"failed {status['upgradesFailed']} "
+        f"quarantined {status.get('slicesQuarantined', 0)}",
         "",
         f"{'GROUP':32s} {'STATE':24s} {'HOSTS':>5s} {'UNAVAIL':>7s} "
         f"{'TOPOLOGY':10s} DCN",

@@ -43,6 +43,10 @@ class UpgradeState(str, enum.Enum):
     DONE = "upgrade-done"
     # Any failure during the upgrade lands here.
     FAILED = "upgrade-failed"
+    # A member of an in-flight slice went NotReady or vanished: the whole
+    # slice is parked, releases its unavailability budget, and rejoins its
+    # prior state after the hardware stays Ready past the hysteresis dwell.
+    QUARANTINED = "quarantined"
 
     def __str__(self) -> str:  # label value
         return self.value
@@ -68,6 +72,10 @@ STATE_ORDER: dict[UpgradeState, int] = {
     UpgradeState.UNCORDON_REQUIRED: 9,
     UpgradeState.DONE: 10,
     UpgradeState.FAILED: 100,
+    # Dominates even FAILED (UpgradeGroup.effective_state checks it first):
+    # a partially-written quarantine batch must resolve to quarantined so
+    # the next pass re-drives the remaining members into the parked state.
+    UpgradeState.QUARANTINED: 200,
 }
 
 
@@ -85,7 +93,11 @@ def parse_state(value: str) -> UpgradeState:
         return UpgradeState.UNKNOWN
 
 # States counted as "upgrade in progress" (reference upgrade_state.go:1055-1062
-# counts everything except unknown/done/upgrade-required).
+# counts everything except unknown/done/upgrade-required).  QUARANTINED is
+# deliberately NOT here: a quarantined slice holds neither a parallel slot
+# nor unavailability budget (it is parked on broken hardware, not being
+# upgraded), and the stuck detector — which walks exactly these states —
+# must treat quarantine as a *reason* for a stall, never a stuck state.
 IN_PROGRESS_STATES: tuple[UpgradeState, ...] = (
     UpgradeState.CORDON_REQUIRED,
     UpgradeState.WAIT_FOR_JOBS_REQUIRED,
@@ -96,6 +108,11 @@ IN_PROGRESS_STATES: tuple[UpgradeState, ...] = (
     UpgradeState.UNCORDON_REQUIRED,
     UpgradeState.FAILED,
 )
+
+# States a slice can be quarantined FROM (and resumed BACK TO): exactly the
+# in-flight states.  A pending (upgrade-required) or finished group has no
+# budget to release, so node loss there needs no special transition.
+QUARANTINABLE_STATES: tuple[UpgradeState, ...] = IN_PROGRESS_STATES
 
 ALL_STATES: tuple[UpgradeState, ...] = tuple(UpgradeState)
 
@@ -149,6 +166,15 @@ STATE_TRANSITIONS: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
      "auto-recovery: pods back in sync AND health gate passes"),
     (_S.FAILED, _S.DONE,
      "auto-recovery (all hosts started cordoned)"),
+) + tuple(
+    # Any in-flight state can lose a host: the slice parks in QUARANTINED
+    # (budget released) and, once every host stays Ready past the
+    # hysteresis dwell, resumes exactly the state it left.
+    (src, _S.QUARANTINED, "member NotReady or vanished mid-roll")
+    for src in QUARANTINABLE_STATES
+) + tuple(
+    (_S.QUARANTINED, dst, "all hosts Ready past quarantine dwell (resume)")
+    for dst in QUARANTINABLE_STATES
 )
 del _S
 
@@ -173,6 +199,19 @@ UPGRADE_VALIDATION_START_TIME_ANNOTATION_KEY_FMT = (
     "{domain}/{driver}-driver-upgrade-validation-start-time"
 )
 UPGRADE_REQUESTED_ANNOTATION_KEY_FMT = "{domain}/{driver}-driver-upgrade-requested"
+# Slice quarantine bookkeeping.  The state label itself flips to
+# "quarantined"; these annotations carry what the label cannot:
+# - prior-state: the in-flight state the slice left, so rejoin resumes
+#   exactly where the roll stopped instead of restarting the ladder;
+# - ready-since: the dwell clock anchor, stamped when every host is first
+#   observed Ready again (group_clock_start pattern) — a readiness flap
+#   clears it, restarting the hysteresis window.
+UPGRADE_QUARANTINE_PRIOR_STATE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-quarantine-prior-state"
+)
+UPGRADE_QUARANTINE_READY_SINCE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-quarantine-ready-since"
+)
 
 # --- TPU-specific keys (new; no reference analogue) ------------------------
 # Slice identity label our topology layer writes/reads when GKE labels are

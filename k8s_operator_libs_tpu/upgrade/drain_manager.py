@@ -25,7 +25,12 @@ from typing import Optional, Sequence
 from k8s_operator_libs_tpu.api.v1alpha1 import DrainSpec
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
-from k8s_operator_libs_tpu.k8s.drain import DrainError, DrainHelper
+from k8s_operator_libs_tpu.k8s.drain import (
+    DrainError,
+    DrainHelper,
+    EscalationStats,
+    escalation_from_spec,
+)
 from k8s_operator_libs_tpu.k8s.objects import Node
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.upgrade.node_state_provider import (
@@ -63,12 +68,17 @@ class DrainManager:
         event_recorder: Optional[EventRecorder] = None,
         max_hosts_concurrency: int = 32,
         poll_interval_s: float = 1.0,
+        escalation_stats: Optional[EscalationStats] = None,
     ) -> None:
         self.client = client
         self.provider = node_state_provider
         self.keys = keys
         self.event_recorder = event_recorder
         self.max_hosts_concurrency = max_hosts_concurrency
+        # Per-rung eviction-escalation counters, usually shared with the
+        # other DrainHelper owners by the upgrade manager so one metrics
+        # read covers every drain path.
+        self.escalation_stats = escalation_stats
         # Apiserver-facing poll cadence for eviction/deletion waits; the
         # production default (1 s, kubectl-like) is deliberately NOT the
         # test default of the cache-sync polls — see ADVICE round 1.
@@ -147,6 +157,10 @@ class DrainManager:
                 timeout_s=float(spec.timeout_second),
                 pod_selector=spec.pod_selector,
                 poll_interval_s=self.poll_interval_s,
+                escalation=escalation_from_spec(
+                    getattr(spec, "eviction_escalation", None)
+                ),
+                escalation_stats=self.escalation_stats,
             )
             policy_failed: list[str] = []
             transient: list[str] = []

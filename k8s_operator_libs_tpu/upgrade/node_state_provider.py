@@ -43,6 +43,17 @@ class CacheSyncTimeout(RuntimeError):
     """The written value never became visible in the read cache."""
 
 
+def node_ready(node: Node) -> bool:
+    """Single source of truth for node readiness.
+
+    A Ready condition with status ``Unknown`` (node-lifecycle controller
+    lost contact with the kubelet) counts as NOT ready — same as
+    ``False`` — because a slice cannot roll on a host whose state is
+    unknowable.  Absent Ready condition counts as ready (matches
+    reference upgrade_state.go:986-993 via Node.is_ready)."""
+    return node.is_ready()
+
+
 class NodeUpgradeStateProvider:
     """Synchronized node label/annotation writes with cache-sync waits."""
 

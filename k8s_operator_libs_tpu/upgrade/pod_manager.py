@@ -31,7 +31,11 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
 )
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
-from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+from k8s_operator_libs_tpu.k8s.drain import (
+    DrainHelper,
+    EscalationConfig,
+    EscalationStats,
+)
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Pod, PodPhase
 from k8s_operator_libs_tpu.k8s.selectors import selector_from_match_labels
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
@@ -82,6 +86,7 @@ class PodManager:
         event_recorder: Optional[EventRecorder] = None,
         max_hosts_concurrency: int = 32,
         poll_interval_s: float = 1.0,
+        escalation_stats: Optional[EscalationStats] = None,
     ) -> None:
         self.client = client
         self.provider = node_state_provider
@@ -89,6 +94,12 @@ class PodManager:
         self.pod_deletion_filter = pod_deletion_filter
         self.event_recorder = event_recorder
         self.max_hosts_concurrency = max_hosts_concurrency
+        # Eviction-escalation ladder: PodDeletionSpec carries no ladder
+        # knobs of its own, so the upgrade manager derives the config from
+        # the policy's drain spec each pass and sets it here; the stats
+        # object is shared across every DrainHelper owner.
+        self.escalation: Optional[EscalationConfig] = None
+        self.escalation_stats = escalation_stats
         # Apiserver-facing poll cadence for eviction waits (kubectl-like
         # 1 s in production; tests pass the suite's fast interval).
         self.poll_interval_s = poll_interval_s
@@ -212,6 +223,8 @@ class PodManager:
                 timeout_s=float(spec.timeout_second),
                 additional_filters=[self.pod_deletion_filter],
                 poll_interval_s=self.poll_interval_s,
+                escalation=self.escalation,
+                escalation_stats=self.escalation_stats,
             )
             total_to_delete = 0
             failed = False

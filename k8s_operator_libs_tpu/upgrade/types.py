@@ -77,6 +77,12 @@ class UpgradeGroup:
             parse_state(m.node.labels.get(state_label_key, ""))
             for m in self.members
         ]
+        # QUARANTINED dominates even FAILED: a crash mid-quarantine-batch
+        # leaves the group half-parked, and the next pass must finish
+        # parking it (budget release is the safety property) rather than
+        # re-drive the un-flipped members through a roll on dead hardware.
+        if UpgradeState.QUARANTINED in states:
+            return UpgradeState.QUARANTINED
         if UpgradeState.FAILED in states:
             return UpgradeState.FAILED
         return min(states, key=lambda s: STATE_ORDER[s])

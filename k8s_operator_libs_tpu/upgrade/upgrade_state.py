@@ -31,11 +31,14 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     TPUUpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import NotFoundError
+from k8s_operator_libs_tpu.k8s.drain import EscalationStats, escalation_from_spec
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
     IN_PROGRESS_STATES,
+    QUARANTINABLE_STATES,
     TRUE_STRING,
     UpgradeState,
 )
@@ -46,6 +49,7 @@ from k8s_operator_libs_tpu.upgrade.drain_manager import (
 )
 from k8s_operator_libs_tpu.upgrade.node_state_provider import (
     NodeUpgradeStateProvider,
+    node_ready,
 )
 from k8s_operator_libs_tpu.upgrade.pod_manager import (
     PodDeletionFilter,
@@ -62,10 +66,14 @@ from k8s_operator_libs_tpu.upgrade.types import (
     UpgradeGroup,
 )
 from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
     EventRecorder,
     StringSet,
     UpgradeKeys,
     WorkerTracker,
+    group_clock_start,
+    log_event,
 )
 from k8s_operator_libs_tpu.upgrade.validation_manager import (
     PodValidationProber,
@@ -157,6 +165,29 @@ class ClusterUpgradeStateManager:
         pending = getattr(self.validation_manager, "pending_rollback", None)
         if pending is not None:
             self.stuck_detector.add_failed_reason_source(pending.get)
+        # Slice quarantine bookkeeping (data-plane fault tolerance):
+        # lifetime totals for metrics plus a per-group reason map the
+        # stuck detector consumes — a group stalled behind a quarantine
+        # must attribute the stall to the hardware loss, never count the
+        # parked time as "stuck in <state>".
+        self.quarantines_total = 0
+        self.rejoins_total = 0
+        self.quarantine_reasons: dict[str, str] = {}
+        self.stuck_detector.add_reason_source(self.quarantine_reasons.get)
+        # One shared per-rung eviction-escalation counter across every
+        # DrainHelper owner (drains, workload-pod deletion, rollback
+        # evictions), so a single metrics read covers all drain paths.
+        self.escalation_stats = EscalationStats()
+        for mgr in (
+            self.drain_manager,
+            self.pod_manager,
+            self.validation_manager,
+        ):
+            if getattr(mgr, "escalation_stats", None) is None:
+                try:
+                    mgr.escalation_stats = self.escalation_stats
+                except AttributeError:
+                    pass  # injected fakes may refuse the attribute
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         # Failed-group recovery probes are rate-limited: with a local
@@ -269,7 +300,21 @@ class ClusterUpgradeStateManager:
             if not pod.spec.node_name:
                 logger.info("driver pod %s has no node, skipping", pod.name)
                 continue
-            node = self.provider.get_node(pod.spec.node_name)
+            try:
+                node = self.provider.get_node(pod.spec.node_name)
+            except NotFoundError:
+                # Node deleted mid-roll (hardware repair, scale-down) with
+                # its driver pod still Terminating: the pod is not part of
+                # the cluster anymore.  Skipping it keeps the snapshot
+                # membership-change-safe — the group rebuilds from the
+                # surviving hosts, no orphaned labels, no double-counted
+                # units.
+                logger.warning(
+                    "node %s for driver pod %s no longer exists, skipping",
+                    pod.spec.node_name,
+                    pod.name,
+                )
+                continue
             nus = NodeUpgradeState(node=node, driver_pod=pod, driver_daemon_set=ds)
             node_states_by_name[node.name] = nus
             label_state = node.labels.get(self.keys.state_label, "")
@@ -366,6 +411,21 @@ class ClusterUpgradeStateManager:
         )
         # Pipelined validation re-cordons a slice whose gate fails.
         self.validation_manager.recordon_on_timeout = pipeline
+
+        # The pod manager's eviction-escalation ladder derives from the
+        # drain spec (PodDeletionSpec carries no ladder knobs of its own).
+        if hasattr(self.pod_manager, "escalation"):
+            self.pod_manager.escalation = escalation_from_spec(
+                getattr(policy.drain_spec, "eviction_escalation", None)
+                if policy.drain_spec is not None
+                else None
+            )
+
+        # Slice quarantine runs BEFORE the slot math: a slice parked this
+        # pass must already have released its unavailability budget when
+        # upgrades_available is computed below, and a slice rejoining is
+        # re-bucketed so the roll resumes in this same pass.
+        self.process_quarantine(current_state, policy)
 
         unit = self._unavailability_unit(policy)
         total_units = self._total_units(current_state, unit)
@@ -854,6 +914,227 @@ class ClusterUpgradeStateManager:
                     annotated, keep_cordoned_key, "null"
                 )
 
+    # -- slice quarantine (data-plane fault tolerance) -----------------------
+
+    @staticmethod
+    def _quarantine_spec(policy):
+        if isinstance(policy, TPUUpgradePolicySpec):
+            return policy.slice_quarantine
+        return None
+
+    def _group_fault_reason(self, group: UpgradeGroup) -> Optional[str]:
+        """Why this group cannot make progress on its hardware, or None
+        if every member is present and Ready (Unknown counts as not
+        ready)."""
+        if (
+            group.slice_info is not None
+            and group.size() < group.slice_info.expected_hosts
+        ):
+            return (
+                f"slice has {group.size()}/"
+                f"{group.slice_info.expected_hosts} hosts visible"
+            )
+        not_ready = sorted(
+            m.node.name for m in group.members if not node_ready(m.node)
+        )
+        if not_ready:
+            return f"node(s) not ready: {', '.join(not_ready)}"
+        return None
+
+    def _move_group_bucket(
+        self,
+        state: ClusterUpgradeState,
+        group: UpgradeGroup,
+        new_state: UpgradeState,
+    ) -> None:
+        """Re-bucket a group (and its members) inside the snapshot after
+        an out-of-band label transition, so the REST of this pass — slot
+        math and processors — sees the group where its labels now say it
+        is, instead of waiting a full build/apply cycle."""
+        for groups in state.groups.values():
+            if group in groups:
+                groups.remove(group)
+        state.groups.setdefault(new_state.value, []).append(group)
+        for members in state.node_states.values():
+            for member in group.members:
+                if member in members:
+                    members.remove(member)
+        state.node_states.setdefault(new_state.value, []).extend(group.members)
+
+    def _clear_quarantine_dwell(self, group: UpgradeGroup) -> None:
+        """Reset the rejoin hysteresis clock (only writes if stamped, so
+        a steadily-broken node doesn't patch annotations every pass)."""
+        key = self.keys.quarantine_ready_since_annotation
+        stamped = [
+            m.node for m in group.members if key in m.node.annotations
+        ]
+        if stamped:
+            self.provider.change_nodes_upgrade_annotation(
+                stamped, key, "null"
+            )
+
+    def process_quarantine(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """Park in-flight groups that lost hardware; rejoin after a dwell.
+
+        Park: any member of an in-flight group NotReady/Unknown, or a
+        host missing from the slice entirely, moves the WHOLE group to
+        ``quarantined`` — the prior state is remembered in an annotation
+        so the roll can resume exactly where it stopped.  A quarantined
+        group holds no parallel slot and no unavailability budget
+        (IN_PROGRESS_STATES excludes it; the unavailability counters
+        skip it explicitly), so the rest of the fleet keeps rolling.
+
+        Rejoin: once every member is back and has stayed Ready for
+        ``ready_dwell_second`` (hysteresis — any flap resets the clock,
+        so a flapping node causes at most one park/rejoin cycle per
+        dwell window), the group transitions back to its prior state and
+        is re-bucketed so it resumes in this same pass."""
+        spec = self._quarantine_spec(policy)
+        enabled = spec is not None and spec.enable
+        dwell_s = int(spec.ready_dwell_second) if spec is not None else 0
+        prior_key = self.keys.quarantine_prior_state_annotation
+        ready_key = self.keys.quarantine_ready_since_annotation
+
+        # Park scan.
+        if enabled:
+            for st in QUARANTINABLE_STATES:
+                for group in list(state.groups_in(st)):
+                    reason = self._group_fault_reason(group)
+                    if reason is None:
+                        continue
+                    logger.warning(
+                        "quarantining group %s (was %s): %s",
+                        group.id,
+                        st.value,
+                        reason,
+                    )
+                    self.provider.change_nodes_upgrade_annotation(
+                        group.nodes, prior_key, st.value
+                    )
+                    self._clear_quarantine_dwell(group)
+                    self.provider.change_nodes_upgrade_state(
+                        group.nodes, UpgradeState.QUARANTINED
+                    )
+                    for node in group.nodes:
+                        log_event(
+                            self.event_recorder,
+                            node.name,
+                            EVENT_TYPE_WARNING,
+                            "SliceQuarantined",
+                            f"Slice quarantined mid-upgrade: {reason}; "
+                            "unavailability budget released; the roll "
+                            "resumes after all hosts stay Ready for "
+                            f"{dwell_s}s",
+                        )
+                    self.quarantines_total += 1
+                    self.quarantine_reasons[group.id] = (
+                        f"quarantined: {reason}"
+                    )
+                    self._move_group_bucket(
+                        state, group, UpgradeState.QUARANTINED
+                    )
+
+        # Rejoin scan (runs even when the feature was just disabled, so
+        # already-parked groups are not wedged forever — dwell still
+        # applies from the last configured spec).
+        now = int(time.time())
+        for group in list(state.groups_in(UpgradeState.QUARANTINED)):
+            reason = self._group_fault_reason(group)
+            if reason is not None:
+                # Still (or again) degraded: reset the dwell clock so a
+                # flapping node can't rejoin before a full quiet window.
+                self._clear_quarantine_dwell(group)
+                self.quarantine_reasons[group.id] = f"quarantined: {reason}"
+                continue
+            start = group_clock_start(self.provider, group, ready_key, now)
+            if start is None:
+                continue  # dwell clock freshly stamped this pass
+            if now - start < dwell_s:
+                continue  # hysteresis: not quiet long enough yet
+            if not self._rejoin_budget_free(state, policy, group):
+                # The roll spent the freed budget on other slices while
+                # this one was parked; rejoining now would exceed
+                # maxUnavailable.  Stay parked (dwell stamp kept) until
+                # a slot frees up.
+                self.quarantine_reasons[group.id] = (
+                    "quarantined: healthy, awaiting unavailability budget"
+                )
+                continue
+            prior_value = ""
+            for member in group.members:
+                prior_value = member.node.annotations.get(prior_key, "")
+                if prior_value:
+                    break
+            try:
+                target = UpgradeState(prior_value)
+            except ValueError:
+                target = UpgradeState.CORDON_REQUIRED
+            if target not in QUARANTINABLE_STATES:
+                # Lost/corrupt prior-state annotation: restart the ladder
+                # from its earliest documented in-flight state (cordon is
+                # idempotent), never invent an undocumented edge.
+                target = UpgradeState.CORDON_REQUIRED
+            logger.info(
+                "group %s rejoining after quarantine -> %s",
+                group.id,
+                target.value,
+            )
+            self.provider.change_nodes_upgrade_state(group.nodes, target)
+            self.provider.change_nodes_upgrade_annotation(
+                group.nodes, prior_key, "null"
+            )
+            self.provider.change_nodes_upgrade_annotation(
+                group.nodes, ready_key, "null"
+            )
+            for node in group.nodes:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_NORMAL,
+                    "SliceRejoined",
+                    "Slice rejoined the upgrade roll after quarantine "
+                    f"(resuming {target.value})",
+                )
+            self.rejoins_total += 1
+            self.quarantine_reasons.pop(group.id, None)
+            self._move_group_bucket(state, group, target)
+
+    def _rejoin_budget_free(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+        group: UpgradeGroup,
+    ) -> bool:
+        """Whether ``group`` can rejoin without busting ``maxUnavailable``.
+
+        A rejoined group re-enters its prior in-flight state with its
+        hosts typically still cordoned, so it re-charges the budget the
+        park released — and the roll may have spent that budget on other
+        slices in the meantime."""
+        if policy is None or policy.max_unavailable is None:
+            return True
+        unit = self._unavailability_unit(policy)
+        cap = policy.max_unavailable.scaled_value(
+            self._total_units(state, unit)
+        )
+        # Charge the rejoin as if fully resumed, even when no member is
+        # cordoned yet (a group parked at cordon-required rejoins with
+        # clean hosts but re-cordons them the same pass).
+        if unit == "slice":
+            charge = 1
+        else:
+            cordoned = sum(
+                1
+                for m in group.members
+                if m.node.spec.unschedulable or not node_ready(m.node)
+            )
+            charge = cordoned or group.size()
+        return self._unavailable_units(state, unit) + charge <= cap
+
     # -- shared helpers ------------------------------------------------------
 
     def _update_group_to_uncordon_or_done(self, group: UpgradeGroup) -> None:
@@ -963,18 +1244,24 @@ class ClusterUpgradeStateManager:
         return len(state.all_groups())
 
     def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
-        """Cordoned or not-ready nodes (upgrade_state.go:192-211)."""
+        """Cordoned or not-ready nodes (upgrade_state.go:192-211).
+
+        Quarantined nodes are excluded: a parked slice's hardware loss
+        must not charge the ``maxUnavailable`` budget, or one broken host
+        would freeze the rest of the fleet's roll for the whole repair."""
         count = 0
-        for states in state.node_states.values():
+        for label, states in state.node_states.items():
+            if label == UpgradeState.QUARANTINED.value:
+                continue
             for nus in states:
-                if nus.node.spec.unschedulable or not nus.node.is_ready():
+                if nus.node.spec.unschedulable or not node_ready(nus.node):
                     count += 1
         return count
 
     def _group_unavailable(self, group: UpgradeGroup) -> bool:
         """A slice with any cordoned/not-ready host is an unavailable slice."""
         return any(
-            m.node.spec.unschedulable or not m.node.is_ready()
+            m.node.spec.unschedulable or not node_ready(m.node)
             for m in group.members
         )
 
@@ -992,7 +1279,7 @@ class ClusterUpgradeStateManager:
         key = self.keys.initial_state_annotation
         return not any(
             (m.node.spec.unschedulable and key not in m.node.annotations)
-            or not m.node.is_ready()
+            or not node_ready(m.node)
             for m in group.members
         )
 
@@ -1022,7 +1309,7 @@ class ClusterUpgradeStateManager:
                             not nus.node.spec.unschedulable
                             or key in nus.node.annotations
                         )
-                        and nus.node.is_ready()
+                        and node_ready(nus.node)
                     ):
                         continue
                     count += 1
@@ -1031,8 +1318,14 @@ class ClusterUpgradeStateManager:
 
     def _unavailable_units(self, state: ClusterUpgradeState, unit: str) -> int:
         if unit == "slice":
+            # Quarantined slices hold no unavailability budget (their
+            # hardware loss is accounted by quarantine, not the roll).
             return sum(
-                1 for g in state.all_groups() if self._group_unavailable(g)
+                1
+                for g in state.all_groups()
+                if self._group_unavailable(g)
+                and g.effective_state(self.keys.state_label)
+                != UpgradeState.QUARANTINED
             )
         return self.get_current_unavailable_nodes(state)
 

@@ -132,6 +132,14 @@ class UpgradeKeys:
         return self._fmt(C.UPGRADE_REQUESTED_ANNOTATION_KEY_FMT)
 
     @property
+    def quarantine_prior_state_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_QUARANTINE_PRIOR_STATE_ANNOTATION_KEY_FMT)
+
+    @property
+    def quarantine_ready_since_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_QUARANTINE_READY_SINCE_ANNOTATION_KEY_FMT)
+
+    @property
     def slice_id_label(self) -> str:
         return self._fmt(C.SLICE_ID_LABEL_KEY_FMT)
 

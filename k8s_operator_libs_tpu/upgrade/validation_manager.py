@@ -103,6 +103,7 @@ class ValidationManager:
         prober: Optional[SliceProber] = None,
         event_recorder: Optional[EventRecorder] = None,
         timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS_DEFAULT,
+        escalation_stats=None,
     ) -> None:
         self.client = client
         self.provider = node_state_provider
@@ -110,6 +111,9 @@ class ValidationManager:
         self.prober = prober
         self.event_recorder = event_recorder
         self.timeout_seconds = timeout_seconds
+        # Shared per-rung eviction counters (rollback evictions count
+        # their evict-rung entries alongside the drain/pod managers').
+        self.escalation_stats = escalation_stats
         # Last rejection reason per group id, consumed by the stuck-state
         # detector so a long validation wait is attributable in events.
         self.last_rejection: dict[str, str] = {}
@@ -242,6 +246,7 @@ class ValidationManager:
             delete_empty_dir_data=True,
             timeout_s=self.rollback_drain_timeout_s,
             poll_interval_s=self.rollback_poll_interval_s,
+            escalation_stats=self.escalation_stats,
         )
         node_names = [n.name for n in group.nodes]
         had_failed_before = group.id in self.pending_rollback

@@ -49,6 +49,10 @@ def _bench_shaped_summary() -> dict:
         "failinj_failed_within_s": 123.456,
         "failinj_recovered": True,
         "failinj_stuck_events": 12,
+        "failinj_quarantines": 12,
+        "failinj_rejoins": 12,
+        "failinj_force_deletes": 12,
+        "failinj_stuck_pod_cleared": True,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,

@@ -17,6 +17,7 @@ import pytest
 from k8s_operator_libs_tpu.api import (
     DrainSpec,
     IntOrString,
+    SliceQuarantineSpec,
     TPUUpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.k8s import (
@@ -328,6 +329,198 @@ def _sliced_upgrade_scenario(cluster, keys, slices=2, hosts=2):
     fx.bump_daemon_set_template(ds, "v2", revision=2)
     fx.auto_recreate_driver_pods(ds, "v2")
     return groups
+
+
+def test_quarantine_roll_converges_after_mid_drain_node_loss():
+    """The data-plane tentpole scenario: a 4-host slice loses a node to
+    NotReady mid-roll.  The slice must park in ``quarantined`` (budget
+    released — the other slice keeps rolling; Degraded condition and
+    gauge derivable), and once the fault schedule clears and the node
+    stays Ready past the dwell, the slice resumes and the roll
+    completes.  Every transition must be a documented edge."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.controller import UpgradeController
+    from k8s_operator_libs_tpu.metrics import UpgradeMetrics
+    from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+    from tests.test_state_diagram import EDGES, _TransitionRecorder
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(store, keys)
+    slices = _sliced_upgrade_scenario(store, keys, slices=2, hosts=4)
+    nodes = [n for ns in slices.values() for n in ns]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=1
+        ),
+    )
+    mgr = ClusterUpgradeStateManager(
+        store, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    metrics = UpgradeMetrics()
+
+    def member_states(name):
+        return {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[name]
+        }
+
+    in_flight_states = {
+        "cordon-required", "wait-for-jobs-required",
+        "pod-deletion-required", "drain-required",
+    }
+    victim = None  # (slice name, node name)
+    cleared = False
+    saw_quarantine = saw_budget_release = False
+    for tick in range(600):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        if victim is None:
+            # Strike the first slice that enters the roll, mid-drain.
+            for name in sorted(slices):
+                if member_states(name) & in_flight_states:
+                    victim = (name, f"{name}-w1")
+                    store.fault_schedule = FaultSchedule().node_down(
+                        victim[1], max_hits=1
+                    )
+                    break
+        quarantined = {
+            name
+            for name in slices
+            if "quarantined" in member_states(name)
+        }
+        if quarantined and not saw_quarantine:
+            saw_quarantine = True
+            assert quarantined == {victim[0]}
+            # The gauge and the Degraded condition are derivable from
+            # exactly this snapshot (the acceptance surface).
+            snap = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            metrics.observe(mgr, snap, 0.0)
+            assert "slices_quarantined 1" in metrics.registry.render()
+            conds = {
+                c["type"]: c
+                for c in UpgradeController._conditions(
+                    {
+                        "quarantinedSlices": len(
+                            snap.groups_in(UpgradeState.QUARANTINED)
+                        )
+                    },
+                    [],
+                )
+            }
+            assert conds["Degraded"]["status"] == "True"
+            assert conds["Degraded"]["reason"] == "SliceQuarantined"
+        if saw_quarantine and not cleared:
+            # Hardware comes back: the fault budget is spent, the
+            # schedule clears, the kubelet reports Ready again.
+            store.fault_schedule.clear()
+            store.set_node_ready(victim[1], True)
+            cleared = True
+        # Budget-release proof: while the victim is parked, the OTHER
+        # slice enters the roll even though maxUnavailable=1.
+        if quarantined:
+            others = set(slices) - quarantined
+            if any(member_states(o) & in_flight_states for o in others):
+                saw_budget_release = True
+        # Per-tick budget: non-quarantined slices with a cordoned host
+        # never exceed the slice-unit budget (the parked slice keeps its
+        # cordons but holds no budget).
+        down = {
+            name
+            for name, ns_ in slices.items()
+            if name not in quarantined
+            and any(
+                store.get_node(n.name, cached=False).spec.unschedulable
+                for n in ns_
+            )
+        }
+        assert len(down) <= 1, (
+            f"tick {tick}: budget exceeded: {sorted(down)}"
+        )
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+        if cleared:
+            _time.sleep(0.01)  # let the 1 s ready-dwell elapse
+    else:
+        pytest.fail(f"never converged: {sorted(states)}")
+
+    assert saw_quarantine and saw_budget_release
+    assert mgr.quarantines_total >= 1
+    assert mgr.rejoins_total >= 1
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, f"undocumented transitions: {undocumented}"
+
+
+def test_flapping_node_one_cycle_per_dwell_window():
+    """A flapping kubelet must cost at most ONE quarantine/rejoin cycle
+    per dwell window: while the node keeps toggling inside the window,
+    the slice stays parked (each flap only resets the dwell clock)."""
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(store, keys)
+    ds = fx.daemon_set()
+    nodes = fx.tpu_slice("flappy-pool", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds)
+    store.patch_node_labels(
+        nodes[0].name, {keys.state_label: "drain-required"}
+    )
+    store.patch_node_labels(
+        nodes[1].name, {keys.state_label: "drain-required"}
+    )
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=3600
+        ),
+    )
+    mgr = ClusterUpgradeStateManager(
+        store, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    def reconcile():
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+
+    # The node goes down once, then flaps: each single-hit rule fires on
+    # the pass's first API call, so every reconcile observes one flip.
+    store.fault_schedule = FaultSchedule().node_down(
+        nodes[1].name, max_hits=1
+    )
+    reconcile()  # park
+    for _ in range(3):
+        store.fault_schedule = FaultSchedule().node_flap(
+            nodes[1].name, max_hits=1
+        )
+        reconcile()  # up: dwell clock starts
+        store.fault_schedule = FaultSchedule().node_flap(
+            nodes[1].name, max_hits=1
+        )
+        reconcile()  # down again: dwell clock resets
+    # Exactly one park, zero rejoins, still parked — not a park/rejoin
+    # storm tracking the flaps.
+    assert mgr.quarantines_total == 1
+    assert mgr.rejoins_total == 0
+    assert (
+        store.get_node(nodes[0].name, cached=False).labels[keys.state_label]
+        == "quarantined"
+    )
 
 
 @pytest.mark.parametrize("tier", ["fake", "rest"])

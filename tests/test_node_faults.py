@@ -1,0 +1,584 @@
+"""Data-plane fault tolerance: node faults, slice quarantine, escalation.
+
+Covers the mid-roll hardware-loss layer end to end at unit/integration
+granularity (the chaos/fuzz tiers drive the same machinery under random
+schedules):
+
+- programmable node faults in the FakeCluster (NotReady, flapping,
+  node deletion, stuck-Terminating finalizers, crash-looping pods);
+- finalizer/grace-period semantics of pod deletion;
+- slice quarantine: park on member loss, budget release, hysteresis
+  dwell, single park/rejoin cycle per dwell window under flapping;
+- membership-change-safe snapshots (node deleted mid-roll);
+- the eviction escalation ladder (evict -> delete -> force-delete) and
+  its per-rung counters, including the policy gating of force-delete.
+"""
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    EvictionEscalationSpec,
+    IntOrString,
+    SliceQuarantineSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.client import NotFoundError
+from k8s_operator_libs_tpu.k8s.drain import (
+    RUNG_DELETE,
+    RUNG_EVICT,
+    RUNG_FORCE_DELETE,
+    DrainError,
+    DrainHelper,
+    EscalationConfig,
+    EscalationStats,
+)
+from k8s_operator_libs_tpu.k8s.faults import FaultSchedule
+from k8s_operator_libs_tpu.metrics import UpgradeMetrics
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import node_ready
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+
+KEYS = UpgradeKeys()
+
+
+def make_manager(client, **kw):
+    return ClusterUpgradeStateManager(
+        client, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0, **kw
+    )
+
+
+def build(mgr, policy=None):
+    return mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+
+
+def tpu_policy(**kw) -> TPUUpgradePolicySpec:
+    return TPUUpgradePolicySpec(auto_upgrade=True, **kw)
+
+
+def quarantine_spec(dwell_s=0, enable=True) -> SliceQuarantineSpec:
+    return SliceQuarantineSpec(enable=enable, ready_dwell_second=dwell_s)
+
+
+# -- data-plane fault injection in the FakeCluster ---------------------------
+
+
+class TestDataPlaneFaults:
+    def test_node_down_fires_on_api_traffic(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(name="host-a")
+        fx.node(name="other")
+        c.fault_schedule = FaultSchedule().node_down("host-a", max_hits=1)
+        # Any verb ticks the fault clock.
+        c.list_nodes()
+        assert c.get_node("host-a").is_ready() is False
+        assert c.get_node("other").is_ready() is True
+        assert n is not None
+
+    def test_node_flap_toggles_readiness(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        fx.node(name="flappy")
+        # Every API call ticks the fault clock, including the get_node
+        # reads themselves — so scope each flap to exactly one hit.
+        c.fault_schedule = FaultSchedule().node_flap("flappy", max_hits=1)
+        assert c.get_node("flappy").is_ready() is False
+        c.fault_schedule = FaultSchedule().node_flap("flappy", max_hits=1)
+        assert c.get_node("flappy").is_ready() is True
+
+    def test_node_delete_removes_node_and_pods(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n = fx.node(name="doomed")
+        fx.driver_pod(n, ds)
+        assert ds.status.desired_number_scheduled == 1
+        c.fault_schedule = FaultSchedule().node_delete("doomed", max_hits=1)
+        c.list_nodes()
+        with pytest.raises(NotFoundError):
+            c.get_node("doomed")
+        with pytest.raises(NotFoundError):
+            c.get_pod(NAMESPACE, "driver-doomed")
+        # The owning DaemonSet's desired count shrank with the node, so
+        # build_state's completeness guard stays coherent.
+        refreshed = c.list_daemon_sets(NAMESPACE, DRIVER_LABELS)[0]
+        assert refreshed.status.desired_number_scheduled == 0
+
+    def test_pod_stick_parks_deletes_in_terminating(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="sticky")
+        c.fault_schedule = FaultSchedule().pod_stick("sticky", max_hits=1)
+        c.list_nodes()  # tick: finalizer attached
+        c.delete_pod(pod.namespace, pod.name)
+        stuck = c.get_pod(pod.namespace, pod.name)
+        assert stuck.is_terminating()
+        # Clearing the finalizers completes the deletion.
+        c.set_pod_finalizers(pod.namespace, pod.name, [])
+        with pytest.raises(NotFoundError):
+            c.get_pod(pod.namespace, pod.name)
+
+    def test_pod_crashloop_bumps_restarts(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n = fx.node(name="cl-host")
+        fx.driver_pod(n, ds)
+        c.fault_schedule = FaultSchedule().pod_crashloop(
+            "driver-cl-host", amount=5, max_hits=2
+        )
+        c.list_nodes()
+        c.list_nodes()
+        pod = c.get_pod(NAMESPACE, "driver-cl-host")
+        st = pod.status.container_statuses[0]
+        assert st.ready is False
+        assert st.restart_count == 10
+
+    def test_control_plane_rules_unaffected_by_data_plane_rules(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        fx.node(name="host-a")
+        sched = (
+            FaultSchedule()
+            .node_down("host-a", max_hits=1)
+            .server_error("list_nodes", max_hits=1)
+        )
+        c.fault_schedule = sched
+        # The error rule still fires even though a data-plane rule
+        # precedes it in the list (decide() skips data-plane kinds).
+        with pytest.raises(Exception):
+            c.list_nodes()
+        assert c.get_node("host-a").is_ready() is False
+
+
+class TestFinalizerGraceSemantics:
+    def test_graceful_delete_honors_finalizers(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="held")
+        c.set_pod_finalizers(pod.namespace, pod.name, ["test/hold"])
+        c.delete_pod(pod.namespace, pod.name)
+        assert c.get_pod(pod.namespace, pod.name).is_terminating()
+
+    def test_grace_zero_bypasses_finalizers(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="held")
+        c.set_pod_finalizers(pod.namespace, pod.name, ["test/hold"])
+        c.delete_pod(pod.namespace, pod.name, grace_period_seconds=0)
+        with pytest.raises(NotFoundError):
+            c.get_pod(pod.namespace, pod.name)
+
+
+class TestNodeReadyHelper:
+    def test_unknown_ready_condition_counts_as_not_ready(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node(name="ghost")
+        n.status.conditions[0].status = "Unknown"
+        assert node_ready(n) is False
+
+    def test_ready_true(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        assert node_ready(fx.node()) is True
+
+
+# -- slice quarantine ---------------------------------------------------------
+
+
+def _sliced_cluster(c, hosts=4, slice_id="s1", state=None, outdated=False):
+    """One driver DS + one TPU slice with per-host driver pods."""
+    fx = ClusterFixture(c)
+    ds = fx.daemon_set()
+    nodes = fx.tpu_slice(slice_id, hosts=hosts, state=state)
+    if outdated:
+        fx.bump_daemon_set_template(ds, "hash-2", 2)
+    for n in nodes:
+        fx.driver_pod(n, ds)
+    return fx, ds, nodes
+
+
+class TestSliceQuarantine:
+    def test_notready_member_quarantines_whole_slice(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[1].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=300))
+        mgr.apply_state(build(mgr, policy), policy)
+        for n in nodes:
+            assert state_of(c, KEYS, n.name) == UpgradeState.QUARANTINED.value
+            anns = c.get_node(n.name).annotations
+            assert (
+                anns[KEYS.quarantine_prior_state_annotation]
+                == UpgradeState.DRAIN_REQUIRED.value
+            )
+        assert mgr.quarantines_total == 1
+        assert "not ready" in mgr.quarantine_reasons["s1"]
+
+    def test_quarantined_slice_releases_budget_same_pass(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        # Slice A mid-drain, cordoned, about to lose a host; slice B
+        # waiting for a slot with outdated pods.
+        a_nodes = fx.tpu_slice(
+            "slice-a", hosts=2, state=UpgradeState.DRAIN_REQUIRED,
+            unschedulable=True,
+        )
+        b_nodes = fx.tpu_slice("slice-b", hosts=2)
+        fx.bump_daemon_set_template(ds, "hash-2", 2)
+        for n in a_nodes + b_nodes:
+            fx.driver_pod(n, ds)  # hash-1 pods: outdated everywhere
+        c.set_node_ready(a_nodes[0].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(
+            unavailability_unit="slice",
+            max_unavailable=IntOrString(1),
+            slice_quarantine=quarantine_spec(dwell_s=300),
+        )
+        # Pass 1 classifies B (unknown -> upgrade-required) and parks A;
+        # pass 2 proves the released budget lets B start.
+        mgr.apply_state(build(mgr, policy), policy)
+        assert (
+            state_of(c, KEYS, a_nodes[0].name)
+            == UpgradeState.QUARANTINED.value
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        assert (
+            state_of(c, KEYS, b_nodes[0].name)
+            == UpgradeState.CORDON_REQUIRED.value
+        )
+
+    def test_budget_not_released_when_quarantine_disabled(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        a_nodes = fx.tpu_slice(
+            "slice-a", hosts=2, state=UpgradeState.DRAIN_REQUIRED,
+            unschedulable=True,
+        )
+        b_nodes = fx.tpu_slice("slice-b", hosts=2)
+        fx.bump_daemon_set_template(ds, "hash-2", 2)
+        for n in a_nodes + b_nodes:
+            fx.driver_pod(n, ds)
+        c.set_node_ready(a_nodes[0].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(
+            unavailability_unit="slice",
+            max_unavailable=IntOrString(1),
+            drain_spec=DrainSpec(enable=True, timeout_second=5),
+            slice_quarantine=quarantine_spec(enable=False),
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.wait_for_async_work()
+        # Slice A still charges maxUnavailable, so B stays paused.
+        assert (
+            state_of(c, KEYS, b_nodes[0].name)
+            == UpgradeState.UPGRADE_REQUIRED.value
+        )
+
+    def test_rejoin_resumes_prior_state_after_dwell(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[1].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=0))
+        mgr.apply_state(build(mgr, policy), policy)  # park
+        c.set_node_ready(nodes[1].name, True)
+        mgr.apply_state(build(mgr, policy), policy)  # stamps dwell clock
+        assert (
+            state_of(c, KEYS, nodes[0].name)
+            == UpgradeState.QUARANTINED.value
+        )
+        mgr.apply_state(build(mgr, policy), policy)  # dwell 0: rejoin
+        # The rejoin re-buckets the group inside the same snapshot, so
+        # the rest of the pass keeps driving it from the RESUMED state —
+        # drain-required continues down the pipeline, never restarting
+        # at cordon.
+        resumed_or_later = {
+            UpgradeState.DRAIN_REQUIRED.value,
+            UpgradeState.POD_DELETION_REQUIRED.value,
+            UpgradeState.POD_RESTART_REQUIRED.value,
+        }
+        for n in nodes:
+            assert state_of(c, KEYS, n.name) in resumed_or_later
+            anns = c.get_node(n.name).annotations
+            assert KEYS.quarantine_prior_state_annotation not in anns
+            assert KEYS.quarantine_ready_since_annotation not in anns
+        assert mgr.rejoins_total == 1
+        assert "s1" not in mgr.quarantine_reasons
+
+    def test_flap_resets_dwell_one_cycle_per_window(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[1].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=3600))
+        mgr.apply_state(build(mgr, policy), policy)  # park
+        c.set_node_ready(nodes[1].name, True)
+        mgr.apply_state(build(mgr, policy), policy)  # stamp dwell clock
+        key = KEYS.quarantine_ready_since_annotation
+        assert key in c.get_node(nodes[1].name).annotations
+        c.set_node_ready(nodes[1].name, False)  # flap!
+        mgr.apply_state(build(mgr, policy), policy)  # clears the clock
+        assert key not in c.get_node(nodes[1].name).annotations
+        c.set_node_ready(nodes[1].name, True)
+        mgr.apply_state(build(mgr, policy), policy)  # fresh stamp
+        mgr.apply_state(build(mgr, policy), policy)  # inside dwell: parked
+        assert (
+            state_of(c, KEYS, nodes[0].name)
+            == UpgradeState.QUARANTINED.value
+        )
+        # Exactly one quarantine, zero rejoins across the whole flap.
+        assert (mgr.quarantines_total, mgr.rejoins_total) == (1, 0)
+        # Backdate the stamp past the dwell: the group finally rejoins.
+        for n in nodes:
+            c.patch_node_annotations(
+                n.name, {key: str(int(time.time()) - 7200)}
+            )
+        mgr.apply_state(build(mgr, policy), policy)
+        assert (
+            state_of(c, KEYS, nodes[0].name)
+            != UpgradeState.QUARANTINED.value
+        )
+        assert (mgr.quarantines_total, mgr.rejoins_total) == (1, 1)
+
+    def test_vanished_member_quarantines_and_membership_safe_rebuild(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.delete_node(nodes[3].name)
+        mgr = make_manager(c)
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=0))
+        state = build(mgr, policy)
+        # The snapshot rebuilt from survivors: no orphaned member, no
+        # double-counted units.
+        (group,) = state.all_groups()
+        assert group.size() == 3
+        mgr.apply_state(state, policy)
+        for n in nodes[:3]:
+            assert (
+                state_of(c, KEYS, n.name) == UpgradeState.QUARANTINED.value
+            )
+        assert "hosts visible" in mgr.quarantine_reasons["s1"]
+
+    def test_quarantine_events_emitted(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[0].name, False)
+        from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+
+        events = EventRecorder()
+        mgr = ClusterUpgradeStateManager(
+            c, keys=KEYS, event_recorder=events,
+            poll_interval_s=0.005, poll_timeout_s=2.0,
+        )
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=0))
+        mgr.apply_state(build(mgr, policy), policy)
+        c.set_node_ready(nodes[0].name, True)
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.apply_state(build(mgr, policy), policy)
+        reasons = [e.reason for e in events.drain()]
+        assert "SliceQuarantined" in reasons
+        assert "SliceRejoined" in reasons
+
+    def test_quarantine_metrics_exported(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[0].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(slice_quarantine=quarantine_spec(dwell_s=300))
+        state = build(mgr, policy)
+        mgr.apply_state(state, policy)
+        metrics = UpgradeMetrics()
+        metrics.observe(mgr, state, 0.01)
+        text = metrics.registry.render()
+        assert "slices_quarantined 1" in text
+        assert "slice_quarantines_total 1" in text
+        assert "slice_rejoins_total 0" in text
+
+    def test_stuck_detector_never_tracks_quarantined(self):
+        c = FakeCluster()
+        fx, ds, nodes = _sliced_cluster(
+            c, state=UpgradeState.DRAIN_REQUIRED
+        )
+        c.set_node_ready(nodes[0].name, False)
+        mgr = make_manager(c)
+        policy = tpu_policy(
+            slice_quarantine=quarantine_spec(dwell_s=300),
+            stuck_threshold_second=0,
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.apply_state(build(mgr, policy), policy)
+        assert "s1" not in mgr.stuck_detector._entered  # not tracked
+        # ...but the reason map attributes the park for observers.
+        assert mgr.stuck_detector.reason_for("s1")
+
+    def test_degraded_condition_slice_quarantined(self):
+        from k8s_operator_libs_tpu.controller import UpgradeController
+
+        status = {
+            "upgradesInProgress": 0,
+            "upgradesPending": 0,
+            "upgradesFailed": 0,
+            "quarantinedSlices": 1,
+            "apiCircuitOpenEndpoints": 0,
+        }
+        conds = {
+            cond["type"]: cond
+            for cond in UpgradeController._conditions(status, [])
+        }
+        assert conds["Degraded"]["status"] == "True"
+        assert conds["Degraded"]["reason"] == "SliceQuarantined"
+        assert conds["Complete"]["status"] == "False"
+
+
+# -- eviction escalation ladder ----------------------------------------------
+
+
+def _ladder_config(force=True):
+    return EscalationConfig(
+        enable=True,
+        evict_timeout_s=0.05,
+        delete_timeout_s=0.05,
+        allow_force_delete=force,
+    )
+
+
+class TestEscalationLadder:
+    def test_ladder_clears_pdb_blocked_finalizer_held_pod(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="blocked")
+        c.set_eviction_blocked(pod.namespace, pod.name, True)
+        c.set_pod_finalizers(pod.namespace, pod.name, ["test/hold"])
+        stats = EscalationStats()
+        helper = DrainHelper(
+            c, force=True, timeout_s=10.0, poll_interval_s=0.01,
+            escalation=_ladder_config(force=True),
+            escalation_stats=stats,
+        )
+        helper.delete_or_evict_pods([pod])
+        with pytest.raises(NotFoundError):
+            c.get_pod(pod.namespace, pod.name)
+        snap = stats.snapshot()
+        assert snap[RUNG_EVICT] == 1
+        assert snap[RUNG_DELETE] == 1
+        assert snap[RUNG_FORCE_DELETE] == 1
+
+    def test_force_rung_needs_explicit_opt_in(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="blocked")
+        c.set_pod_finalizers(pod.namespace, pod.name, ["test/hold"])
+        stats = EscalationStats()
+        helper = DrainHelper(
+            c, force=True, timeout_s=0.3, poll_interval_s=0.01,
+            escalation=_ladder_config(force=False),
+            escalation_stats=stats,
+        )
+        with pytest.raises(DrainError):
+            helper.delete_or_evict_pods([pod])
+        snap = stats.snapshot()
+        assert snap[RUNG_EVICT] == 1
+        assert snap[RUNG_DELETE] == 1
+        assert snap.get(RUNG_FORCE_DELETE, 0) == 0
+        # Pod survives: force-delete never ran without the opt-in.
+        assert c.get_pod(pod.namespace, pod.name).is_terminating()
+
+    def test_disabled_ladder_never_escalates(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        n = fx.node()
+        pod = fx.workload_pod(n, name="blocked")
+        c.set_pod_finalizers(pod.namespace, pod.name, ["test/hold"])
+        stats = EscalationStats()
+        helper = DrainHelper(
+            c, force=True, timeout_s=0.3, poll_interval_s=0.01,
+            escalation_stats=stats,
+        )
+        with pytest.raises(DrainError):
+            helper.delete_or_evict_pods([pod])
+        assert stats.snapshot().get(RUNG_DELETE, 0) == 0
+
+    def test_drain_manager_plumbs_spec_and_shared_stats(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        nodes = fx.tpu_slice(
+            "esc-slice", hosts=2, state=UpgradeState.DRAIN_REQUIRED
+        )
+        for n in nodes:
+            fx.driver_pod(n, ds)
+        sticky = fx.workload_pod(nodes[0], name="stuck-wl")
+        c.set_pod_finalizers(sticky.namespace, sticky.name, ["test/hold"])
+        mgr = make_manager(c, drain_poll_interval_s=0.01)
+        policy = tpu_policy(
+            drain_spec=DrainSpec(
+                enable=True,
+                timeout_second=10,
+                delete_empty_dir=True,
+                force=True,
+                eviction_escalation=EvictionEscalationSpec(
+                    enable=True,
+                    evict_timeout_second=0,
+                    delete_timeout_second=0,
+                    allow_force_delete=True,
+                ),
+            ),
+            slice_quarantine=quarantine_spec(enable=False),
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        assert mgr.wait_for_async_work(timeout_s=30.0)
+        with pytest.raises(NotFoundError):
+            c.get_pod(sticky.namespace, sticky.name)
+        # Counters land on the manager-owned shared stats object.
+        snap = mgr.escalation_stats.snapshot()
+        assert snap[RUNG_FORCE_DELETE] == 1
+        assert (
+            state_of(c, KEYS, nodes[0].name)
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )
+
+    def test_pod_manager_escalation_derived_from_drain_spec(self):
+        c = FakeCluster()
+        mgr = make_manager(c)
+        policy = tpu_policy(
+            drain_spec=DrainSpec(
+                enable=True,
+                eviction_escalation=EvictionEscalationSpec(enable=True),
+            )
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        assert mgr.pod_manager.escalation is not None
+        assert mgr.pod_manager.escalation.enable is True
+        # And it clears when the policy drops the ladder.
+        mgr.apply_state(build(mgr, policy), tpu_policy())
+        assert mgr.pod_manager.escalation is None
