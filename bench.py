@@ -534,6 +534,14 @@ class RollHarness:
             hbm_floor_fraction=0.5,
         )
         self.mgr.with_validation_enabled(self.prober)
+        # Crash-safety wiring mirroring the controller: a fence the
+        # async workers consult, flipped dark when crash_controller()
+        # "kills" the engine mid-roll.
+        self._alive = {"up": True}
+        self.mgr.fence = lambda a=self._alive: a["up"]
+        self._needs_adoption = False
+        self.controller_kills = 0
+        self.last_adopt_summary: dict = {}
         self.policy = TPUUpgradePolicySpec(
             auto_upgrade=True,
             # DCN mode allows 2 slices in flight; anti-affinity is what
@@ -600,6 +608,35 @@ class RollHarness:
         # Per-DCN-ring concurrency high-water mark (dcn mode): the
         # anti-affinity invariant is that this never exceeds 1.
         self.max_ring_unavailable = 0
+
+    # -- controller crash / rebuild -----------------------------------------
+
+    def crash_controller(self) -> None:
+        """SIGKILL analogue for the engine: fence the old manager dark
+        (its in-flight drain/eviction/rollback workers abandon instead
+        of racing the successor), join the orphans, then stand up a
+        fresh manager against the same cluster and prober.  ``run()``
+        re-adopts the durable annotations on its next tick, so ladders
+        resume at their persisted rung."""
+        self._alive["up"] = False
+        self.mgr.wait_for_async_work(60.0)
+        old = self.mgr
+        self._alive = {"up": True}
+        self.controller_kills += 1
+        self.mgr = ClusterUpgradeStateManager(
+            self.cluster, keys=self.keys,
+            event_recorder=self.event_recorder,
+            poll_interval_s=0.02, poll_timeout_s=5.0,
+        )
+        self.mgr.with_validation_enabled(self.prober)
+        self.mgr.recovery_probe_backoff_s = old.recovery_probe_backoff_s
+        # A real restart resets process counters (rate() absorbs that),
+        # but the bench artifact reports the ROLL's totals — carry them
+        # across incarnations so a kill can't hide a quarantine.
+        self.mgr.quarantines_total += old.quarantines_total
+        self.mgr.rejoins_total += old.rejoins_total
+        self.mgr.fence = lambda a=self._alive: a["up"]
+        self._needs_adoption = True
 
     # -- agent fleet --------------------------------------------------------
 
@@ -733,6 +770,13 @@ class RollHarness:
             except NotFoundError:
                 time.sleep(0.05)
                 continue
+            if self._needs_adoption:
+                self.last_adopt_summary = self.mgr.adopt(
+                    state,
+                    identity=f"bench-{self.controller_kills}",
+                    term=self.controller_kills,
+                )
+                self._needs_adoption = False
             self.mgr.apply_state(state, self.policy)
             self.mgr.wait_for_async_work(60.0)
             beat()  # roll tick completed — the bench is alive
@@ -869,7 +913,36 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
 
     q_victim = harness.slices[2][1].name
 
+    # Controller-kill stage: the engine is killed (fence dark, workers
+    # joined) and rebuilt WHILE pool-3's eviction ladder is climbing past
+    # the finalizer-stuck pod, so recovery exercises re-adoption of the
+    # persisted rung.  ticks_to_recover counts reconcile passes from the
+    # kill until the rebuilt engine visibly advances any node's state.
+    ctrl: dict = {"tick": 0, "kill_tick": None, "kill_states": None}
+
     def on_tick(states, t) -> None:
+        ctrl["tick"] += 1
+        s3 = states.get(harness.slices[3][0].name, "")
+        if ctrl["kill_tick"] is None:
+            if s3 == "drain-required":
+                harness.crash_controller()
+                ctrl["kill_tick"] = ctrl["tick"]
+                ctrl["kill_states"] = dict(states)
+                timeline["t_controller_killed"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: controller killed "
+                    f"mid-drain of pool-3 (tick {ctrl['tick']}); "
+                    "rebuilt, awaiting re-adoption"
+                )
+        elif "t_controller_recovered" not in timeline:
+            if states != ctrl["kill_states"]:
+                ctrl["recovery_ticks"] = ctrl["tick"] - ctrl["kill_tick"]
+                timeline["t_controller_recovered"] = round(t, 2)
+                log(
+                    f"  t={t:7.2f}s fail-inject: rebuilt controller "
+                    f"resumed the roll after {ctrl['recovery_ticks']} "
+                    "tick(s)"
+                )
         # Quarantine stage (pool-2), independent of pool-1's timeline.
         s2 = states.get(harness.slices[2][0].name, "")
         if "t_node_down" not in timeline:
@@ -961,6 +1034,12 @@ def failure_injection_roll(devices, cpu_fallback: bool) -> dict:
         "rejoins": harness.mgr.rejoins_total,
         "escalations": harness.mgr.escalation_stats.snapshot(),
         "stuck_pod_cleared": stuck_pod_cleared,
+        "controller_kill": {
+            "kills": harness.controller_kills,
+            "kill_tick": ctrl["kill_tick"],
+            "recovery_ticks": ctrl.get("recovery_ticks"),
+            "adopted": harness.last_adopt_summary,
+        },
         "validation_timeout_s": FAILINJ_VALIDATION_TIMEOUT_S,
         "stuck_threshold_s": FAILINJ_STUCK_THRESHOLD_S,
         "timeline": timeline,
@@ -1228,7 +1307,8 @@ def main() -> None:
         f"{failinj['stuck_events_naming_victim']} quarantines="
         f"{failinj['quarantines']} rejoins={failinj['rejoins']} "
         f"escalations={failinj['escalations']} stuck_pod_cleared="
-        f"{failinj['stuck_pod_cleared']} complete={failinj['complete']}"
+        f"{failinj['stuck_pod_cleared']} controller_kill="
+        f"{failinj['controller_kill']} complete={failinj['complete']}"
     )
 
     # -- device-sustained canary throughput ----------------------------------
@@ -1357,6 +1437,10 @@ def main() -> None:
             "force_delete", 0
         ),
         "failinj_stuck_pod_cleared": failinj["stuck_pod_cleared"],
+        "failinj_ctrl_kills": failinj["controller_kill"]["kills"],
+        "failinj_ctrl_recovery_ticks": failinj["controller_kill"][
+            "recovery_ticks"
+        ],
         "mxu_tflops": _num(mxu.get("tflops"), 1),
         "mxu_mfu": _num(mxu.get("mfu"), 3),
         "hbm_gbps": _num(hbm.get("gbps"), 1),

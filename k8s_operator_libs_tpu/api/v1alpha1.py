@@ -89,6 +89,7 @@ _JSON_NAME_OVERRIDES = {
     "evict_timeout_second": "evictTimeoutSeconds",
     "delete_timeout_second": "deleteTimeoutSeconds",
     "ready_dwell_second": "readyDwellSeconds",
+    "pdb_grace_second": "pdbGraceSeconds",
 }
 
 
@@ -191,11 +192,21 @@ class EvictionEscalationSpec(_SpecBase):
     delete_timeout_second: int = 300
     # Allow the final rung: delete with gracePeriodSeconds=0.
     allow_force_delete: bool = False
+    # PDB-aware hold: extra seconds a pod whose evictions are rejected
+    # by a PodDisruptionBudget may stay at the evict rung PAST
+    # evictTimeoutSeconds before escalating to a PDB-bypassing delete —
+    # the budget releasing is plausibly imminent, so keep asking instead
+    # of timing out blind.  0 disables the hold.
+    pdb_grace_second: int = 0
 
     def validate(self) -> None:
         if self.evict_timeout_second < 0 or self.delete_timeout_second < 0:
             raise ValidationError(
                 "evictionEscalation timeouts must be >= 0"
+            )
+        if self.pdb_grace_second < 0:
+            raise ValidationError(
+                "evictionEscalation.pdbGraceSeconds must be >= 0"
             )
 
 
@@ -329,11 +340,20 @@ class SliceQuarantineSpec(_SpecBase):
     # Seconds every host must stay Ready before the slice rejoins the
     # roll.  The dwell clock restarts on any readiness flap.
     ready_dwell_second: int = 300
+    # Cap on quarantine cycles per slice: hardware that keeps flapping
+    # across dwell windows demotes to upgrade-failed (with a
+    # QuarantineCycleLimit event) once it has been parked this many
+    # times, instead of park/rejoin thrashing forever.  0 = unlimited.
+    max_cycles: int = 3
 
     def validate(self) -> None:
         if self.ready_dwell_second < 0:
             raise ValidationError(
                 "sliceQuarantine.readyDwellSeconds must be >= 0"
+            )
+        if self.max_cycles < 0:
+            raise ValidationError(
+                "sliceQuarantine.maxCycles must be >= 0"
             )
 
 

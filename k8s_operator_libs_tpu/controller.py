@@ -170,7 +170,21 @@ class UpgradeController:
                 namespace=config.lease_namespace or config.namespace,
                 name=config.lease_name,
             )
+            # Crash-safety fence: every async worker (drain, eviction,
+            # rollback) consults this before mutating, so a deposed
+            # leader's in-flight workers abandon instead of racing the
+            # successor.  Reads ``self.elector`` at call time — tests and
+            # embedders may swap the elector after construction.
+            self.manager.fence = (
+                lambda: self.elector is None or self.elector.is_leader()
+            )
         self._stop = False
+        # Re-adoption: the first reconcile pass of every leadership epoch
+        # (and of a non-HA process lifetime) rebuilds in-memory progress
+        # — escalation ladders, rollback attempts, probe backoffs — from
+        # the durable annotation record instead of from zero.
+        self._needs_adoption = True
+        self._adoptions = 0
         # Policy-CR bookkeeping: the CR fetched this pass (reused for the
         # status write) and whether "missing" was already logged.
         self._policy_cr: Optional[dict] = None
@@ -221,6 +235,20 @@ class UpgradeController:
             # term expires.
             if not self._still_leading():
                 return False
+            if self._needs_adoption:
+                identity = (
+                    self.elector.identity
+                    if self.elector is not None
+                    else (self.config.identity or "standalone")
+                )
+                term = self.elector.term if self.elector is not None else 0
+                self.manager.adopt(state, identity=identity, term=term)
+                self._needs_adoption = False
+                self._adoptions += 1
+                self.registry.set(
+                    "controller_adoptions_total", float(self._adoptions)
+                )
+                self.registry.set("controller_leader_term", float(term))
             self.manager.apply_state(state, self.config.policy)
         except CircuitOpenError as e:
             self._handle_circuit_open(e)
@@ -427,6 +455,24 @@ class UpgradeController:
                     state.groups_in(UpgradeState.QUARANTINED)
                 ),
                 "apiCircuitOpenEndpoints": self._open_circuit_count(),
+                # Escalation/rollback telemetry (crash-safe: seeded from
+                # the durable annotation record on adoption, so these
+                # survive controller restarts and leader handoffs).
+                "evictionEscalations": {
+                    rung: count
+                    for rung, count in sorted(
+                        m.escalation_stats.snapshot().items()
+                    )
+                    if count
+                },
+                "rollbackAttempts": dict(
+                    sorted(
+                        getattr(
+                            m.validation_manager, "rollback_attempts", {}
+                        ).items()
+                    )
+                ),
+                "quarantineCycleDemotions": m.quarantine_cycle_demotions,
             }
             status["conditions"] = self._conditions(
                 status, (cr.get("status") or {}).get("conditions") or []
@@ -610,11 +656,16 @@ class UpgradeController:
         )
         if leading != self._was_leader:
             logger.info(
-                "%s leadership (lease=%s identity=%s)",
+                "%s leadership (lease=%s identity=%s term=%d)",
                 "gained" if leading else "lost",
                 self.config.lease_name,
                 e.identity,
+                e.term,
             )
+            if leading:
+                # New leadership epoch: the next pass re-adopts in-flight
+                # state from the durable record before acting on it.
+                self._needs_adoption = True
         self._was_leader = leading
         if self._pump_gate is not None:
             if leading:

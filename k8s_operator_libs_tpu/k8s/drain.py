@@ -35,10 +35,22 @@ class DrainError(RuntimeError):
     pass
 
 
+class FencedError(RuntimeError):
+    """Raised by an async drain worker whose leader lost the lease.
+
+    A deposed leader's orphaned workers must stop acting the moment the
+    fence trips — the new leader has re-adopted their in-flight work from
+    the persisted record, and a late write from the old term would race
+    it.  Callers treat this as "abandon quietly", never as a drain
+    failure (the slice must NOT flip to upgrade-failed because leadership
+    moved)."""
+
+
 # Ladder rungs, in escalation order.
 RUNG_EVICT = "evict"
 RUNG_DELETE = "delete"
 RUNG_FORCE_DELETE = "force_delete"
+ALL_RUNGS = (RUNG_EVICT, RUNG_DELETE, RUNG_FORCE_DELETE)
 
 
 @dataclass
@@ -59,6 +71,13 @@ class EscalationConfig:
     evict_timeout_s: float = 30.0
     delete_timeout_s: float = 30.0
     allow_force_delete: bool = False
+    # PDB-aware hold: a pod whose evictions are being rejected by a
+    # PodDisruptionBudget (429s) holds at the evict rung for this long
+    # PAST evict_timeout_s before climbing to delete — the budget
+    # releasing is plausibly imminent (a sibling pod terminating frees
+    # disruptionsAllowed), so keep asking instead of timing out blind.
+    # 0 disables the hold (legacy behavior: escalate on the raw timeout).
+    pdb_grace_s: float = 0.0
 
 
 class EscalationStats:
@@ -98,6 +117,7 @@ def escalation_from_spec(spec) -> Optional[EscalationConfig]:
         evict_timeout_s=float(spec.evict_timeout_second),
         delete_timeout_s=float(spec.delete_timeout_second),
         allow_force_delete=bool(spec.allow_force_delete),
+        pdb_grace_s=float(getattr(spec, "pdb_grace_second", 0) or 0),
     )
 
 
@@ -136,6 +156,8 @@ class DrainHelper:
         eviction_retry_interval_s: Optional[float] = None,
         escalation: Optional[EscalationConfig] = None,
         escalation_stats: Optional[EscalationStats] = None,
+        fence: Optional[Callable[[], bool]] = None,
+        rung_store=None,
     ) -> None:
         self.client = client
         self.force = force
@@ -158,6 +180,16 @@ class DrainHelper:
         )
         self.escalation = escalation
         self.escalation_stats = escalation_stats
+        # Leadership fence: checked before every mutating round.  False
+        # aborts the drain with FencedError — a deposed leader's worker
+        # must not evict/delete after handoff.
+        self.fence = fence
+        # Durable ladder clocks: an object with
+        # load(node) -> (rung, epoch)|None, save(node, rung, epoch),
+        # clear(node) — backed by node annotations upstream so a restarted
+        # controller resumes each node's ladder at its persisted rung with
+        # the original entry time, not back at rung 0.
+        self.rung_store = rung_store
 
     # -- cordon ------------------------------------------------------------
 
@@ -232,13 +264,57 @@ class DrainHelper:
         by_key = {(p.namespace, p.name): p for p in pods}
         pending = set(by_key)  # pods not yet confirmed gone
         issued = set()  # pods whose current rung's API call succeeded
+        pdb_blocked = set()  # pods whose last eviction hit a PDB 429
         now = time.monotonic()
-        rung = {key: RUNG_EVICT for key in by_key}
-        rung_since = {key: now for key in by_key}
+        now_epoch = int(time.time())
+        node_of = {
+            key: (getattr(p.spec, "node_name", "") or "")
+            for key, p in by_key.items()
+        }
+        # Durable ladder resume: a node whose annotation records a rung
+        # beyond evict re-enters the ladder AT that rung with the original
+        # entry time (epoch→monotonic rebased), so a controller restart
+        # mid-escalation continues the countdown instead of restarting it.
+        store = (
+            self.rung_store
+            if (self.rung_store is not None and esc is not None and esc.enable)
+            else None
+        )
+        persisted_by_node: dict[str, Optional[tuple[str, int]]] = {}
+        if store is not None:
+            for node in sorted({n for n in node_of.values() if n}):
+                persisted_by_node[node] = store.load(node)
+        rung = {}
+        rung_since = {}
+        resumed = set()
+        for key in by_key:
+            persisted = persisted_by_node.get(node_of[key])
+            if persisted is not None:
+                r, since_epoch = persisted
+                if r == RUNG_FORCE_DELETE and not esc.allow_force_delete:
+                    r = RUNG_DELETE
+                if r in ALL_RUNGS:
+                    rung[key] = r
+                    rung_since[key] = now - max(0, now_epoch - since_epoch)
+                    resumed.add(key)
+                    continue
+            rung[key] = RUNG_EVICT
+            rung_since[key] = now
         if self.escalation_stats is not None:
             for key in by_key:
-                self.escalation_stats.record(RUNG_EVICT)
+                if key not in resumed:
+                    self.escalation_stats.record(RUNG_EVICT)
+        if store is not None:
+            for node in sorted(
+                {node_of[k] for k in by_key if k not in resumed and node_of[k]}
+            ):
+                if persisted_by_node.get(node) is None:
+                    store.save(node, RUNG_EVICT, now_epoch)
         while True:
+            if self.fence is not None and not self.fence():
+                raise FencedError(
+                    "drain abandoned: leadership lost mid-eviction"
+                )
             backoff_s = 0.0
             # Escalate pods that outlived their rung's budget — whether
             # the rung's call keeps failing (PDB 429s) or it succeeded
@@ -253,6 +329,19 @@ class DrainHelper:
                         rung[key] == RUNG_EVICT
                         and overdue > esc.evict_timeout_s
                     ):
+                        # PDB-aware hold: the pod's evictions are being
+                        # rejected by a disruption budget.  Releasing is
+                        # plausibly imminent (a sibling terminating frees
+                        # disruptionsAllowed), so keep retrying evictions
+                        # for the grace window before escalating to a
+                        # PDB-bypassing delete.
+                        if (
+                            key in pdb_blocked
+                            and esc.pdb_grace_s > 0
+                            and overdue
+                            <= esc.evict_timeout_s + esc.pdb_grace_s
+                        ):
+                            continue
                         rung[key] = RUNG_DELETE
                     elif (
                         rung[key] == RUNG_DELETE
@@ -266,6 +355,8 @@ class DrainHelper:
                     issued.discard(key)
                     if self.escalation_stats is not None:
                         self.escalation_stats.record(rung[key])
+                    if store is not None and node_of[key]:
+                        store.save(node_of[key], rung[key], int(time.time()))
             for key in sorted(pending - issued):
                 ns, name = key
                 try:
@@ -284,6 +375,7 @@ class DrainHelper:
                     # PDB: retry next round, but back off — re-POSTing a
                     # blocked eviction every poll hammers the apiserver for
                     # no benefit (the PDB won't release that fast).
+                    pdb_blocked.add(key)
                     backoff_s = max(backoff_s, self.eviction_retry_interval_s)
                     continue
                 except ThrottledError as e:
@@ -295,6 +387,7 @@ class DrainHelper:
                     )
                     break
                 issued.add(key)
+                pdb_blocked.discard(key)
                 if self.on_pod_deleted is not None:
                     self.on_pod_deleted(by_key[key], True)
             # Wait for evicted pods to vanish (kubectl waits for deletion).
@@ -307,6 +400,17 @@ class DrainHelper:
                 except ThrottledError:
                     break  # back off this round; deadline still applies
             pending -= gone
+            if store is not None and gone:
+                # A node whose tracked pods are all gone is done with the
+                # ladder: drop its persisted rung so the NEXT drain cycle
+                # starts fresh at evict instead of inheriting this one's
+                # escalation.
+                remaining_nodes = {node_of[k] for k in pending}
+                for node in sorted(
+                    {node_of[k] for k in gone if node_of[k]}
+                    - remaining_nodes
+                ):
+                    store.clear(node)
             if not pending:
                 return
             if deadline is not None and time.monotonic() > deadline:

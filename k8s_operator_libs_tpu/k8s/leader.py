@@ -120,8 +120,22 @@ class LeaderElector:
         self._observed_at = 0.0
         # Last persistent-error message logged (transition-logged only).
         self._last_error: Optional[str] = None
+        # Fencing term: the leaseTransitions value this process wrote
+        # when it last WON the lease (0 = created, N = takeover number).
+        # -1 until first win.  leaseTransitions is bumped on every
+        # takeover, so (identity, term) uniquely names a leadership
+        # epoch — the re-adoption pass stamps it into the adoption
+        # annotation and a deposed leader's stale workers can be told
+        # apart from the live term's.
+        self._term = -1
 
     # -- public surface ------------------------------------------------------
+
+    @property
+    def term(self) -> int:
+        """The leaseTransitions number of this process's current (or most
+        recent) leadership epoch; -1 if it never held the lease."""
+        return self._term
 
     def is_leader(self) -> bool:
         """Held AND renewed within the deadline.  A holder that cannot
@@ -224,6 +238,7 @@ class LeaderElector:
                 LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL,
                 self.namespace, created,
             )
+            self._term = 0
             self._won(now)
             logger.info(
                 "lease %s/%s acquired by %s (created)",
@@ -252,6 +267,7 @@ class LeaderElector:
 
         renewing = holder == self.identity
         transitions = int(spec.get("leaseTransitions") or 0)
+        new_transitions = transitions if renewing else transitions + 1
         lease["spec"] = self._spec(
             now,
             acquire=(
@@ -259,7 +275,7 @@ class LeaderElector:
                 if renewing
                 else now
             ),
-            transitions=transitions if renewing else transitions + 1,
+            transitions=new_transitions,
         )
         # update carries the fetched resourceVersion: a concurrent writer
         # bumps it and this PUT conflicts — exactly one winner per term.
@@ -267,6 +283,7 @@ class LeaderElector:
             LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, self.namespace, lease
         )
         became = not self._is_leader
+        self._term = new_transitions
         self._won(now)
         if became and not renewing:
             logger.info(

@@ -154,8 +154,23 @@ class UpgradeMetrics:
         )
         r.describe(
             "eviction_escalations_total",
-            "Eviction-ladder rung entries since controller start",
+            "Eviction-ladder rung entries since controller start "
+            "(re-seeded from persisted rung annotations on adoption)",
             "rung",
+        )
+        r.describe(
+            "quarantine_cycle_demotions_total",
+            "Slices demoted quarantined -> upgrade-failed after flapping "
+            "across the configured number of dwell windows",
+        )
+        r.describe(
+            "controller_adoptions_total",
+            "Re-adoption passes run (one per leadership epoch / process "
+            "start)",
+        )
+        r.describe(
+            "controller_leader_term",
+            "leaseTransitions number of the current leadership epoch",
         )
         r.describe(
             "api_circuit_open_endpoints",
@@ -201,6 +216,10 @@ class UpgradeMetrics:
             getattr(manager, "quarantines_total", 0),
         )
         r.set("slice_rejoins_total", getattr(manager, "rejoins_total", 0))
+        r.set(
+            "quarantine_cycle_demotions_total",
+            getattr(manager, "quarantine_cycle_demotions", 0),
+        )
         esc_stats = getattr(manager, "escalation_stats", None)
         if esc_stats is not None and hasattr(esc_stats, "snapshot"):
             for rung, count in sorted(esc_stats.snapshot().items()):

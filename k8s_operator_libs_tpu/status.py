@@ -63,9 +63,15 @@ def gather(
                 policy_ref[0],
                 policy_ref[1],
             )
+            cr_status = cr.get("status") or {}
             policy_section = {
                 "spec": cr.get("spec") or {},
-                "conditions": (cr.get("status") or {}).get("conditions", []),
+                "conditions": cr_status.get("conditions", []),
+                # Lifetime counters the controller publishes (crash-safe:
+                # re-seeded from annotations on leader adoption).
+                "evictionEscalations": cr_status.get("evictionEscalations")
+                or {},
+                "rollbackAttempts": cr_status.get("rollbackAttempts") or {},
             }
             try:
                 policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
@@ -78,6 +84,15 @@ def gather(
         state = mgr.build_state(namespace, driver_labels, policy)
     except BuildStateError as e:
         return {"error": f"snapshot incoherent: {e} (mid-rollout; retry)"}
+    from k8s_operator_libs_tpu.upgrade.durable import parse_int
+
+    rung_key = keys.eviction_rung_annotation
+    attempts_key = keys.rollback_attempts_annotation
+    cycles_key = keys.quarantine_cycle_count_annotation
+    # Nodes currently mid-escalation, per persisted ladder rung — read
+    # from the durable annotations, so this is correct even while no
+    # controller is running (or right after a leader handoff).
+    escalations_in_flight: dict[str, int] = {}
     groups = []
     for group in sorted(state.all_groups(), key=lambda g: g.id):
         effective = group.effective_state(keys.state_label).value or "idle"
@@ -90,12 +105,32 @@ def gather(
             for m in group.members
             if m.node.spec.unschedulable or not node_ready(m.node)
         )
+        for m in group.members:
+            rung = m.node.annotations.get(rung_key, "")
+            if rung:
+                escalations_in_flight[rung] = (
+                    escalations_in_flight.get(rung, 0) + 1
+                )
         groups.append(
             {
                 "group": group.id,
                 "state": effective,
                 "hosts": group.size(),
                 "unavailable": unavailable,
+                "rollbackAttempts": max(
+                    (
+                        parse_int(m.node.annotations.get(attempts_key))
+                        for m in group.members
+                    ),
+                    default=0,
+                ),
+                "quarantineCycles": max(
+                    (
+                        parse_int(m.node.annotations.get(cycles_key))
+                        for m in group.members
+                    ),
+                    default=0,
+                ),
                 "quarantined": effective == UpgradeState.QUARANTINED.value,
                 "accelerator": (
                     group.slice_info.accelerator if group.slice_info else ""
@@ -121,6 +156,7 @@ def gather(
         "slicesQuarantined": len(
             state.groups_in(UpgradeState.QUARANTINED)
         ),
+        "evictionEscalationsInFlight": escalations_in_flight,
         "groups": groups,
     }
     if policy_section is not None:
@@ -205,6 +241,33 @@ def render(status: dict) -> str:
             f"{g['group'][:32]:32s} {g['state']:24s} {g['hosts']:>5d} "
             f"{g['unavailable']:>7d} {g['topology']:10s} {g['dcn_group']}"
         )
+    esc = status.get("evictionEscalationsInFlight") or {}
+    if esc:
+        lines.append("")
+        lines.append(
+            "eviction ladders in flight (nodes at rung): "
+            + ", ".join(f"{r}={n}" for r, n in sorted(esc.items()))
+        )
+    rollbacks = {
+        g["group"]: g["rollbackAttempts"]
+        for g in status["groups"]
+        if g.get("rollbackAttempts")
+    }
+    if rollbacks:
+        lines.append(
+            "rollback attempts: "
+            + ", ".join(f"{gid}={n}" for gid, n in sorted(rollbacks.items()))
+        )
+    cycles = {
+        g["group"]: g["quarantineCycles"]
+        for g in status["groups"]
+        if g.get("quarantineCycles")
+    }
+    if cycles:
+        lines.append(
+            "quarantine cycles: "
+            + ", ".join(f"{gid}={n}" for gid, n in sorted(cycles.items()))
+        )
     leader = status.get("leader")
     if leader is not None:
         lines.append("")
@@ -223,6 +286,22 @@ def render(status: dict) -> str:
                     f"condition {c.get('type', ''):12s} "
                     f"{c.get('status', ''):6s} {c.get('reason', '')}: "
                     f"{c.get('message', '')}"
+                )
+            lifetime = policy.get("evictionEscalations") or {}
+            if lifetime:
+                lines.append(
+                    "escalations (lifetime): "
+                    + ", ".join(
+                        f"{r}={int(n)}" for r, n in sorted(lifetime.items())
+                    )
+                )
+            rb = policy.get("rollbackAttempts") or {}
+            if rb:
+                lines.append(
+                    "rollback attempts (lifetime): "
+                    + ", ".join(
+                        f"{gid}={int(n)}" for gid, n in sorted(rb.items())
+                    )
                 )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):

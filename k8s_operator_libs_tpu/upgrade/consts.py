@@ -175,6 +175,9 @@ STATE_TRANSITIONS: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
 ) + tuple(
     (_S.QUARANTINED, dst, "all hosts Ready past quarantine dwell (resume)")
     for dst in QUARANTINABLE_STATES
+) + (
+    (_S.QUARANTINED, _S.FAILED,
+     "quarantine cycle limit reached (hardware flapping across dwells)"),
 )
 del _S
 
@@ -211,6 +214,52 @@ UPGRADE_QUARANTINE_PRIOR_STATE_ANNOTATION_KEY_FMT = (
 )
 UPGRADE_QUARANTINE_READY_SINCE_ANNOTATION_KEY_FMT = (
     "{domain}/{driver}-driver-upgrade-quarantine-ready-since"
+)
+# How many times the slice has been parked (incremented at park time).
+# Past SliceQuarantineSpec.max_cycles the slice demotes to upgrade-failed
+# (QuarantineCycleLimit) instead of flapping across dwell windows forever.
+UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-quarantine-cycle-count"
+)
+
+# --- durable in-flight progress clocks -------------------------------------
+# Every escalation/backoff decision the controller makes mid-roll is
+# externalized into node annotations through the same idempotent patch
+# path as the state label, so a controller crash or leader handoff
+# resumes ladders and backoff windows where they stopped instead of
+# restarting them from zero (and double-spending disruption budget).
+#
+# - eviction-rung: the highest eviction-ladder rung reached for the
+#   node's pods ("evict" | "delete" | "force_delete");
+# - eviction-rung-since: epoch seconds when that rung was entered (the
+#   ladder's dwell clock — a new leader resumes the countdown, it does
+#   not restart it);
+# - rollback-attempts: count of rollback eviction attempts for a FAILED
+#   pipelined-validation slice;
+# - rollback-last-attempt: epoch seconds of the newest attempt (backoff
+#   anchor for retry_pending_rollbacks);
+# - recovery-probe-since: epoch seconds of the newest auto-recovery
+#   health probe for a FAILED slice (probe dedupe across leader terms);
+# - adopted-by: "<leader identity>@<lease term>" fencing stamp written
+#   by the re-adoption pass on leader acquisition; a deposed leader's
+#   stale workers observe a foreign stamp/term and must not act.
+UPGRADE_EVICTION_RUNG_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-eviction-rung"
+)
+UPGRADE_EVICTION_RUNG_SINCE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-eviction-rung-since"
+)
+UPGRADE_ROLLBACK_ATTEMPTS_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-rollback-attempts"
+)
+UPGRADE_ROLLBACK_LAST_ATTEMPT_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-rollback-last-attempt"
+)
+UPGRADE_RECOVERY_PROBE_SINCE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-recovery-probe-since"
+)
+UPGRADE_ADOPTED_BY_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-adopted-by"
 )
 
 # --- TPU-specific keys (new; no reference analogue) ------------------------

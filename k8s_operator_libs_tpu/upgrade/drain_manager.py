@@ -29,6 +29,7 @@ from k8s_operator_libs_tpu.k8s.drain import (
     DrainError,
     DrainHelper,
     EscalationStats,
+    FencedError,
     escalation_from_spec,
 )
 from k8s_operator_libs_tpu.k8s.objects import Node
@@ -83,6 +84,12 @@ class DrainManager:
         # production default (1 s, kubectl-like) is deliberately NOT the
         # test default of the cache-sync polls — see ADVICE round 1.
         self.poll_interval_s = poll_interval_s
+        # Crash-safety hooks wired by the upgrade manager: a leadership
+        # fence every async worker consults before mutating, and the
+        # annotation-backed store that persists each node's eviction-
+        # ladder rung so a fresh leader resumes mid-escalation.
+        self.fence = None
+        self.rung_store = None
         # Dedup of in-flight drains across reconcile passes
         # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
         self._draining = StringSet()
@@ -149,6 +156,8 @@ class DrainManager:
         in ``drain-required`` so the next idempotent pass simply retries
         the drain."""
         try:
+            if self.fence is not None and not self.fence():
+                return  # deposed leader: abandon without acting
             helper = DrainHelper(
                 self.client,
                 force=spec.force,
@@ -161,6 +170,8 @@ class DrainManager:
                     getattr(spec, "eviction_escalation", None)
                 ),
                 escalation_stats=self.escalation_stats,
+                fence=self.fence,
+                rung_store=self.rung_store,
             )
             policy_failed: list[str] = []
             transient: list[str] = []
@@ -185,6 +196,11 @@ class DrainManager:
                     for fut, node in futures.items():
                         try:
                             fut.result()
+                        except FencedError:
+                            # Leadership moved mid-drain: abandon quietly.
+                            # The new leader re-adopts from the persisted
+                            # rungs; any transition here would race it.
+                            return
                         except DrainError as e:
                             logger.error(
                                 "failed to drain %s: %s", node.name, e
@@ -206,7 +222,10 @@ class DrainManager:
                             )
                             transient.append(node.name)
 
-            # Group barrier: all-or-nothing transition.
+            # Group barrier: all-or-nothing transition — fenced, so a
+            # deposed leader's worker cannot flip the slice after handoff.
+            if self.fence is not None and not self.fence():
+                return
             if policy_failed:
                 self.last_error[group.id] = (
                     f"drain policy failure on host(s) {policy_failed}"

@@ -1,0 +1,132 @@
+"""Durable in-flight progress clocks (crash-safe controller state).
+
+The label mailbox makes the *state machine* stateless between passes,
+but PRs 1-2 accumulated controller-process memory around it: eviction
+ladder rungs and their entry clocks, rollback attempt counts and backoff
+anchors, recovery-probe dedupe timestamps.  All of it evaporated on a
+controller crash or leader handoff, silently resetting escalation
+ladders and double-spending disruption budget under the new leader.
+
+This module externalizes those clocks into node annotations written
+through the same idempotent patch path as everything else:
+
+- :class:`AnnotationRungStore` — per-node eviction-ladder rung + entry
+  epoch, plugged into :class:`~k8s_operator_libs_tpu.k8s.drain.DrainHelper`
+  so a fresh controller resumes each ladder AT its persisted rung;
+- epoch annotation read/write helpers shared by the rollback-backoff and
+  recovery-probe persistence in the validation/upgrade managers;
+- the adoption fencing stamp ("<identity>@<term>") the re-adoption pass
+  writes on leader acquisition.
+
+All writes here are best-effort: losing a clock write degrades to the
+pre-crash-safety behavior (ladder restarts at evict), it must never fail
+the drain or the reconcile pass itself.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.drain import ALL_RUNGS
+from k8s_operator_libs_tpu.k8s.interface import KubeClient
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+
+logger = get_logger(__name__)
+
+
+def parse_epoch(value: Optional[str]) -> Optional[int]:
+    """Parse an epoch-seconds annotation value; garbage reads as absent."""
+    if not value:
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        return None
+
+
+def parse_int(value: Optional[str], default: int = 0) -> int:
+    try:
+        return int(value) if value else default
+    except ValueError:
+        return default
+
+
+def monotonic_from_epoch(epoch: int, now_epoch: Optional[int] = None) -> float:
+    """Rebase a persisted wall-clock anchor onto this process's monotonic
+    clock, preserving elapsed time (clamped so a skewed future stamp can
+    not produce a negative elapsed)."""
+    if now_epoch is None:
+        now_epoch = int(time.time())
+    return time.monotonic() - max(0, now_epoch - epoch)
+
+
+class AnnotationRungStore:
+    """Node-annotation persistence for the eviction escalation ladder.
+
+    One record per node (the ladder's unit of work in every call site:
+    node drains and slice evictions both group pods by host): the highest
+    rung reached and the epoch it was entered.  Multiple workload pods on
+    one host share the record — resume uses the max rung, which is the
+    conservative direction (never *restarts* an escalation the old
+    leader already committed to).
+    """
+
+    def __init__(self, client: KubeClient, keys: UpgradeKeys) -> None:
+        self.client = client
+        self.keys = keys
+
+    def load(self, node_name: str) -> Optional[tuple[str, int]]:
+        try:
+            node = self.client.get_node(node_name, cached=False)
+        except Exception:
+            return None
+        rung = node.annotations.get(self.keys.eviction_rung_annotation)
+        since = parse_epoch(
+            node.annotations.get(self.keys.eviction_rung_since_annotation)
+        )
+        if rung not in ALL_RUNGS or since is None:
+            return None
+        return rung, since
+
+    def save(self, node_name: str, rung: str, epoch: int) -> None:
+        try:
+            self.client.patch_node_annotations(
+                node_name,
+                {
+                    self.keys.eviction_rung_annotation: rung,
+                    self.keys.eviction_rung_since_annotation: str(epoch),
+                },
+            )
+        except Exception as e:  # best-effort: never fail the drain
+            logger.debug("rung save for %s failed: %s", node_name, e)
+
+    def clear(self, node_name: str) -> None:
+        try:
+            self.client.patch_node_annotations(
+                node_name,
+                {
+                    self.keys.eviction_rung_annotation: None,
+                    self.keys.eviction_rung_since_annotation: None,
+                },
+            )
+        except Exception as e:
+            logger.debug("rung clear for %s failed: %s", node_name, e)
+
+
+def format_adoption_stamp(identity: str, term: int) -> str:
+    return f"{identity}@{term}"
+
+
+def parse_adoption_stamp(value: Optional[str]) -> Optional[tuple[str, int]]:
+    """Parse "<identity>@<term>"; identity may itself contain '@'."""
+    if not value:
+        return None
+    ident, sep, term = value.rpartition("@")
+    if not sep:
+        return None
+    parsed = parse_epoch(term)
+    if parsed is None:
+        return None
+    return ident, parsed

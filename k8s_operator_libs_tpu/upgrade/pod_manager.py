@@ -35,6 +35,7 @@ from k8s_operator_libs_tpu.k8s.drain import (
     DrainHelper,
     EscalationConfig,
     EscalationStats,
+    FencedError,
 )
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Pod, PodPhase
 from k8s_operator_libs_tpu.k8s.selectors import selector_from_match_labels
@@ -100,6 +101,10 @@ class PodManager:
         # object is shared across every DrainHelper owner.
         self.escalation: Optional[EscalationConfig] = None
         self.escalation_stats = escalation_stats
+        # Crash-safety hooks wired by the upgrade manager (see
+        # drain_manager.py): leadership fence + durable rung store.
+        self.fence = None
+        self.rung_store = None
         # Apiserver-facing poll cadence for eviction waits (kubectl-like
         # 1 s in production; tests pass the suite's fast interval).
         self.poll_interval_s = poll_interval_s
@@ -215,6 +220,8 @@ class PodManager:
         self, group: UpgradeGroup, spec: PodDeletionSpec, drain_enabled: bool
     ) -> None:
         try:
+            if self.fence is not None and not self.fence():
+                return  # deposed leader: abandon without acting
             helper = DrainHelper(
                 self.client,
                 force=spec.force,
@@ -225,6 +232,8 @@ class PodManager:
                 poll_interval_s=self.poll_interval_s,
                 escalation=self.escalation,
                 escalation_stats=self.escalation_stats,
+                fence=self.fence,
+                rung_store=self.rung_store,
             )
             total_to_delete = 0
             failed = False
@@ -256,6 +265,10 @@ class PodManager:
                 return
             try:
                 helper.delete_or_evict_pods(deletable)
+            except FencedError:
+                # Leadership moved mid-eviction: abandon quietly; the new
+                # leader resumes from the persisted ladder rungs.
+                return
             except Exception as e:  # noqa: BLE001
                 logger.error("failed to delete pods in group %s: %s", group.id, e)
                 for node in group.nodes:

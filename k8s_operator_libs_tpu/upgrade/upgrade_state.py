@@ -32,7 +32,11 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
 )
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import NotFoundError
-from k8s_operator_libs_tpu.k8s.drain import EscalationStats, escalation_from_spec
+from k8s_operator_libs_tpu.k8s.drain import (
+    ALL_RUNGS,
+    EscalationStats,
+    escalation_from_spec,
+)
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
@@ -43,6 +47,13 @@ from k8s_operator_libs_tpu.upgrade.consts import (
     UpgradeState,
 )
 from k8s_operator_libs_tpu.upgrade.cordon_manager import CordonManager
+from k8s_operator_libs_tpu.upgrade.durable import (
+    AnnotationRungStore,
+    format_adoption_stamp,
+    monotonic_from_epoch,
+    parse_epoch,
+    parse_int,
+)
 from k8s_operator_libs_tpu.upgrade.drain_manager import (
     DrainConfiguration,
     DrainManager,
@@ -172,6 +183,9 @@ class ClusterUpgradeStateManager:
         # parked time as "stuck in <state>".
         self.quarantines_total = 0
         self.rejoins_total = 0
+        # Slices demoted quarantined -> upgrade-failed after flapping
+        # across the configured number of dwell windows (satellite cap).
+        self.quarantine_cycle_demotions = 0
         self.quarantine_reasons: dict[str, str] = {}
         self.stuck_detector.add_reason_source(self.quarantine_reasons.get)
         # One shared per-rung eviction-escalation counter across every
@@ -188,6 +202,26 @@ class ClusterUpgradeStateManager:
                     mgr.escalation_stats = self.escalation_stats
                 except AttributeError:
                     pass  # injected fakes may refuse the attribute
+        # Durable eviction-ladder store (crash safety): per-node rung +
+        # entry epoch persisted as annotations, shared into every
+        # DrainHelper owner the same way as escalation_stats so a fresh
+        # leader resumes each ladder AT its committed rung, never rung 0.
+        self.rung_store = AnnotationRungStore(client, self.keys)
+        for mgr in (
+            self.drain_manager,
+            self.pod_manager,
+            self.validation_manager,
+        ):
+            if getattr(mgr, "rung_store", None) is None:
+                try:
+                    mgr.rung_store = self.rung_store
+                except AttributeError:
+                    pass  # injected fakes may refuse the attribute
+        # Leadership fence: the controller sets this to "is this process
+        # still the live leader?" and the setter fans it out to every
+        # async-worker owner, so a deposed leader's in-flight workers
+        # abandon (FencedError) instead of mutating after handoff.
+        self._fence = None
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         # Failed-group recovery probes are rate-limited: with a local
@@ -253,6 +287,129 @@ class ClusterUpgradeStateManager:
 
     def is_validation_enabled(self) -> bool:
         return self._validation_enabled
+
+    # -- crash safety: fencing + re-adoption ---------------------------------
+
+    @property
+    def fence(self):
+        """Leadership fence callable (True while this process may act)."""
+        return self._fence
+
+    @fence.setter
+    def fence(self, fn) -> None:
+        self._fence = fn
+        for mgr in (
+            self.drain_manager,
+            self.pod_manager,
+            self.validation_manager,
+        ):
+            try:
+                mgr.fence = fn
+            except AttributeError:
+                pass  # injected fakes may refuse the attribute
+
+    def adopt(
+        self,
+        state: ClusterUpgradeState,
+        identity: str = "",
+        term: int = -1,
+    ) -> dict[str, int]:
+        """Re-adoption pass: run ONCE when this process acquires the
+        lease (or starts without HA), against a fresh snapshot.
+
+        The label mailbox already carries the *state machine* position;
+        this rebuilds the controller-process memory that PRs 1-2 grew
+        around it — from the durable record, not from zero:
+
+        - escalation counters re-seeded from persisted per-node ladder
+          rungs (a resumed force-delete ladder is visible in metrics);
+        - rollback attempt counts / backoff anchors re-read from
+          annotations (``validation_manager.adopt``), so FAILED groups
+          whose eviction completeness is unknown are re-tracked as
+          pending rollbacks instead of silently forgotten;
+        - recovery-probe rejection clocks rebased from their persisted
+          epochs, so a crash does not void the probe backoff window;
+        - every in-flight node stamped ``<identity>@<term>`` so actions
+          of a deposed leader's term are distinguishable from this one's.
+        """
+        summary = {"groups": 0, "rungs": 0, "rollbacks": 0, "probes": 0}
+        now_epoch = int(time.time())
+
+        # (a) Seed the shared escalation counters from persisted rungs:
+        # one record per node, counting every rung up to the committed
+        # one (the ladder climbed through them to get there).
+        rung_key = self.keys.eviction_rung_annotation
+        for members in state.node_states.values():
+            for nus in members:
+                persisted = nus.node.annotations.get(rung_key)
+                if persisted in ALL_RUNGS:
+                    for rung in ALL_RUNGS:
+                        self.escalation_stats.record(rung)
+                        if rung == persisted:
+                            break
+                    summary["rungs"] += 1
+
+        # (b) Rollback attempt counts + retry backoff (validation layer).
+        adopt_rollbacks = getattr(self.validation_manager, "adopt", None)
+        if adopt_rollbacks is not None:  # injected fakes may lack it
+            summary["rollbacks"] = adopt_rollbacks(state)
+
+        # (c) Recovery-probe dedupe: a rejection inside the persisted
+        # backoff window keeps the battery from re-running immediately
+        # on the new leader's first pass.
+        probe_key = self.keys.recovery_probe_since_annotation
+        for group in state.groups_in(UpgradeState.FAILED):
+            epochs = [
+                e
+                for e in (
+                    parse_epoch(m.node.annotations.get(probe_key))
+                    for m in group.members
+                )
+                if e is not None
+            ]
+            if epochs:
+                with self._recovery_lock:
+                    self._recovery_rejections[group.id] = monotonic_from_epoch(
+                        max(epochs), now_epoch
+                    )
+                summary["probes"] += 1
+
+        # (d) Fencing stamp on every in-flight node.  Best-effort: a
+        # failed stamp degrades observability, never the adoption.
+        stamp = format_adoption_stamp(identity or "unknown", term)
+        adopt_key = self.keys.adopted_by_annotation
+        for st in tuple(IN_PROGRESS_STATES) + (
+            UpgradeState.FAILED,
+            UpgradeState.QUARANTINED,
+        ):
+            for group in state.groups_in(st):
+                summary["groups"] += 1
+                stale = [
+                    m.node
+                    for m in group.members
+                    if m.node.annotations.get(adopt_key) != stamp
+                ]
+                if stale:
+                    try:
+                        self.provider.change_nodes_upgrade_annotation(
+                            stale, adopt_key, stamp
+                        )
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        logger.warning(
+                            "adoption stamp for group %s failed: %s",
+                            group.id,
+                            e,
+                        )
+        logger.info(
+            "re-adoption (%s): %d in-flight group(s), %d persisted "
+            "ladder rung(s), %d pending rollback(s), %d probe backoff(s)",
+            stamp,
+            summary["groups"],
+            summary["rungs"],
+            summary["rollbacks"],
+            summary["probes"],
+        )
+        return summary
 
     # -- BuildState (upgrade_state.go:214-279) -------------------------------
 
@@ -850,6 +1007,29 @@ class ClusterUpgradeStateManager:
                         self._recovery_rejections.pop(group.id, None)
                     else:
                         self._recovery_rejections[group.id] = time.monotonic()
+                # Persist the rejection epoch (crash safety): a restarted
+                # leader rebases it in adopt() and honors the remaining
+                # backoff instead of immediately re-running the battery.
+                probe_key = self.keys.recovery_probe_since_annotation
+                try:
+                    if result.healthy:
+                        stamped = [
+                            m.node
+                            for m in group.members
+                            if probe_key in m.node.annotations
+                        ]
+                        if stamped:
+                            self.provider.change_nodes_upgrade_annotation(
+                                stamped, probe_key, "null"
+                            )
+                    else:
+                        self.provider.change_nodes_upgrade_annotation(
+                            group.nodes, probe_key, str(int(time.time()))
+                        )
+                except Exception as e:  # noqa: BLE001 — best-effort clock
+                    logger.debug(
+                        "probe backoff stamp for %s failed: %s", group.id, e
+                    )
                 if not result.healthy:
                     logger.info(
                         "failed group %s stays failed: health gate "
@@ -996,8 +1176,12 @@ class ClusterUpgradeStateManager:
         spec = self._quarantine_spec(policy)
         enabled = spec is not None and spec.enable
         dwell_s = int(spec.ready_dwell_second) if spec is not None else 0
+        max_cycles = (
+            int(getattr(spec, "max_cycles", 0) or 0) if spec is not None else 0
+        )
         prior_key = self.keys.quarantine_prior_state_annotation
         ready_key = self.keys.quarantine_ready_since_annotation
+        cycle_key = self.keys.quarantine_cycle_count_annotation
 
         # Park scan.
         if enabled:
@@ -1014,6 +1198,20 @@ class ClusterUpgradeStateManager:
                     )
                     self.provider.change_nodes_upgrade_annotation(
                         group.nodes, prior_key, st.value
+                    )
+                    # Durable flap counter: one increment per park, so a
+                    # slice cycling across dwell windows is capped below
+                    # (max_cycles) instead of parking forever — and the
+                    # count survives controller restarts.
+                    cycles = 1 + max(
+                        (
+                            parse_int(m.node.annotations.get(cycle_key))
+                            for m in group.members
+                        ),
+                        default=0,
+                    )
+                    self.provider.change_nodes_upgrade_annotation(
+                        group.nodes, cycle_key, str(cycles)
                     )
                     self._clear_quarantine_dwell(group)
                     self.provider.change_nodes_upgrade_state(
@@ -1043,6 +1241,49 @@ class ClusterUpgradeStateManager:
         # applies from the last configured spec).
         now = int(time.time())
         for group in list(state.groups_in(UpgradeState.QUARANTINED)):
+            # Cycle cap: a slice that flapped across max_cycles dwell
+            # windows is hardware that keeps lying about being back —
+            # demote to upgrade-failed (documented QUARANTINED->FAILED
+            # edge) so it surfaces for repair instead of parking forever.
+            cycles = max(
+                (
+                    parse_int(m.node.annotations.get(cycle_key))
+                    for m in group.members
+                ),
+                default=0,
+            )
+            if max_cycles > 0 and cycles >= max_cycles:
+                logger.warning(
+                    "group %s hit the quarantine cycle limit (%d/%d): "
+                    "demoting to upgrade-failed",
+                    group.id,
+                    cycles,
+                    max_cycles,
+                )
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.FAILED
+                )
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes, prior_key, "null"
+                )
+                self._clear_quarantine_dwell(group)
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_WARNING,
+                        "QuarantineCycleLimit",
+                        f"Slice quarantined {cycles} times "
+                        f"(limit {max_cycles}): hardware is flapping; "
+                        "demoted to upgrade-failed for repair",
+                    )
+                self.quarantine_cycle_demotions += 1
+                self.quarantine_reasons[group.id] = (
+                    f"quarantine cycle limit reached ({cycles}/"
+                    f"{max_cycles}); demoted to upgrade-failed"
+                )
+                self._move_group_bucket(state, group, UpgradeState.FAILED)
+                continue
             reason = self._group_fault_reason(group)
             if reason is not None:
                 # Still (or again) degraded: reset the dwell clock so a
@@ -1159,6 +1400,32 @@ class ClusterUpgradeStateManager:
             getattr(self.validation_manager, "pending_rollback", {}).pop(
                 group.id, None
             )
+        self.quarantine_reasons.pop(group.id, None)
+        # The upgrade cycle is complete: retire this cycle's durable
+        # progress clocks so the NEXT cycle starts with a clean ladder,
+        # flap count, and attempt record.  Guarded per key (only nodes
+        # actually carrying it), so the common path writes nothing.
+        for key in (
+            self.keys.quarantine_cycle_count_annotation,
+            self.keys.eviction_rung_annotation,
+            self.keys.eviction_rung_since_annotation,
+            self.keys.rollback_attempts_annotation,
+            self.keys.rollback_last_attempt_annotation,
+            self.keys.recovery_probe_since_annotation,
+            self.keys.adopted_by_annotation,
+        ):
+            carriers = [
+                m.node for m in group.members if key in m.node.annotations
+            ]
+            if carriers:
+                try:
+                    self.provider.change_nodes_upgrade_annotation(
+                        carriers, key, "null"
+                    )
+                except Exception as e:  # noqa: BLE001 — best-effort retire
+                    logger.warning(
+                        "clearing %s on group %s failed: %s", key, group.id, e
+                    )
         key = self.keys.initial_state_annotation
         if all(key in m.node.annotations for m in group.members):
             self.provider.change_nodes_upgrade_state(

@@ -140,6 +140,34 @@ class UpgradeKeys:
         return self._fmt(C.UPGRADE_QUARANTINE_READY_SINCE_ANNOTATION_KEY_FMT)
 
     @property
+    def quarantine_cycle_count_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT)
+
+    @property
+    def eviction_rung_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_EVICTION_RUNG_ANNOTATION_KEY_FMT)
+
+    @property
+    def eviction_rung_since_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_EVICTION_RUNG_SINCE_ANNOTATION_KEY_FMT)
+
+    @property
+    def rollback_attempts_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ROLLBACK_ATTEMPTS_ANNOTATION_KEY_FMT)
+
+    @property
+    def rollback_last_attempt_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ROLLBACK_LAST_ATTEMPT_ANNOTATION_KEY_FMT)
+
+    @property
+    def recovery_probe_since_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_RECOVERY_PROBE_SINCE_ANNOTATION_KEY_FMT)
+
+    @property
+    def adopted_by_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ADOPTED_BY_ANNOTATION_KEY_FMT)
+
+    @property
     def slice_id_label(self) -> str:
         return self._fmt(C.SLICE_ID_LABEL_KEY_FMT)
 

@@ -151,6 +151,112 @@ class ValidationManager:
         # attempt: the completion Normal event fires only for nodes that
         # actually had a failure to close out (not the whole group).
         self._rollback_failed_nodes: dict[str, list[str]] = {}
+        # group id -> rollback attempt count (mirrored into the
+        # rollback-attempts node annotation so it survives a controller
+        # crash and surfaces in the status CLI).
+        self.rollback_attempts: dict[str, int] = {}
+        # Crash-safety hooks wired by the upgrade manager: leadership
+        # fence for the async rollback workers + durable rung store for
+        # their eviction ladders.
+        self.fence = None
+        self.rung_store = None
+
+    # -- durable rollback clocks --------------------------------------------
+
+    def _persist_rollback_attempt(self, group: UpgradeGroup) -> int:
+        """Increment the group's rollback-attempts annotation and stamp
+        the last-attempt epoch (best-effort: a lost write degrades to a
+        restarted backoff window after a crash, never fails the pass)."""
+        from k8s_operator_libs_tpu.upgrade.durable import parse_int
+
+        attempts = max(
+            (
+                parse_int(
+                    n.annotations.get(self.keys.rollback_attempts_annotation)
+                )
+                for n in group.nodes
+            ),
+            default=0,
+        )
+        attempts = max(attempts, self.rollback_attempts.get(group.id, 0)) + 1
+        self.rollback_attempts[group.id] = attempts
+        try:
+            self.provider.change_nodes_upgrade_annotation(
+                group.nodes,
+                self.keys.rollback_attempts_annotation,
+                str(attempts),
+            )
+            self.provider.change_nodes_upgrade_annotation(
+                group.nodes,
+                self.keys.rollback_last_attempt_annotation,
+                str(int(time.time())),
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort persistence
+            logger.warning(
+                "failed to persist rollback clock for group %s: %s",
+                group.id,
+                e,
+            )
+        return attempts
+
+    def adopt(self, state) -> int:
+        """Re-adoption pass (leader acquisition): rebuild the pending-
+        rollback ledger from the persisted record instead of from zero.
+
+        A FAILED group whose nodes carry a rollback-attempts annotation
+        had an in-flight (or blocked) rollback eviction under the old
+        leader; re-enter it in ``pending_rollback`` so
+        :meth:`retry_pending_rollbacks` re-drives it, with the persisted
+        last-attempt epoch rebased onto this process's monotonic clock so
+        the backoff window CONTINUES rather than restarting.  Returns the
+        number of groups adopted."""
+        from k8s_operator_libs_tpu.upgrade.durable import (
+            monotonic_from_epoch,
+            parse_epoch,
+            parse_int,
+        )
+
+        adopted = 0
+        for group in state.groups_in(UpgradeState.FAILED):
+            attempts = max(
+                (
+                    parse_int(
+                        n.annotations.get(
+                            self.keys.rollback_attempts_annotation
+                        )
+                    )
+                    for n in group.nodes
+                ),
+                default=0,
+            )
+            if attempts <= 0:
+                continue
+            self.rollback_attempts[group.id] = max(
+                attempts, self.rollback_attempts.get(group.id, 0)
+            )
+            if group.id not in self.pending_rollback:
+                self.pending_rollback[group.id] = (
+                    f"re-adopted after leader change ({attempts} prior "
+                    "rollback attempt(s)); eviction completeness unknown"
+                )
+                adopted += 1
+            last_epoch = max(
+                (
+                    parse_epoch(
+                        n.annotations.get(
+                            self.keys.rollback_last_attempt_annotation
+                        )
+                    )
+                    or 0
+                    for n in group.nodes
+                ),
+                default=0,
+            )
+            if last_epoch > 0:
+                self._rollback_last_attempt[group.id] = monotonic_from_epoch(
+                    last_epoch
+                )
+        return adopted
 
     def clear_pending_rollback(self, group_id: str) -> None:
         """Stop tracking a group's pending rollback eviction: clears the
@@ -161,6 +267,7 @@ class ValidationManager:
         self.pending_rollback.pop(group_id, None)
         self._rollback_last_attempt.pop(group_id, None)
         self._rollback_failed_nodes.pop(group_id, None)
+        self.rollback_attempts.pop(group_id, None)
 
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
@@ -232,13 +339,16 @@ class ValidationManager:
         ``slice_stuck_seconds`` + events), and the engine re-attempts on
         later passes via :meth:`retry_pending_rollbacks` — the drain is
         idempotent, so eviction completes once the blocker clears."""
-        from k8s_operator_libs_tpu.k8s.drain import DrainHelper
+        from k8s_operator_libs_tpu.k8s.drain import DrainHelper, FencedError
 
+        if self.fence is not None and not self.fence():
+            return  # deposed leader: the new leader re-adopts this work
         with self._rollback_lock:
             if group.id in self._rollback_active:
                 return  # a worker is already evicting this group
             self._rollback_active.add(group.id)
 
+        self._persist_rollback_attempt(group)
         helper = DrainHelper(
             self.client,
             force=True,
@@ -247,6 +357,8 @@ class ValidationManager:
             timeout_s=self.rollback_drain_timeout_s,
             poll_interval_s=self.rollback_poll_interval_s,
             escalation_stats=self.escalation_stats,
+            fence=self.fence,
+            rung_store=self.rung_store,
         )
         node_names = [n.name for n in group.nodes]
         had_failed_before = group.id in self.pending_rollback
@@ -257,6 +369,11 @@ class ValidationManager:
                 for name in node_names:
                     try:
                         helper.run_node_drain(name)
+                    except FencedError:
+                        # Leadership moved mid-rollback: stop acting.  The
+                        # persisted rollback-attempts annotation lets the
+                        # new leader re-adopt the unfinished eviction.
+                        return
                     except Exception as e:  # noqa: BLE001 — retried later
                         failures.append((name, e))
                         logger.error(
@@ -307,6 +424,32 @@ class ValidationManager:
                             "Rollback eviction completed after earlier "
                             "failures; no workload pods remain on the "
                             "unvalidated hardware",
+                        )
+                if not failures:
+                    # Eviction is complete: retire the durable rollback
+                    # clocks so a later leader does not re-adopt finished
+                    # work (best-effort; re-adopting a finished eviction
+                    # is idempotent anyway).
+                    try:
+                        for key in (
+                            self.keys.rollback_attempts_annotation,
+                            self.keys.rollback_last_attempt_annotation,
+                        ):
+                            self.provider.change_nodes_upgrade_annotation(
+                                [
+                                    n
+                                    for n in group.nodes
+                                    if key in n.annotations
+                                ],
+                                key,
+                                "null",
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "failed to clear rollback clocks for group "
+                            "%s: %s",
+                            group.id,
+                            e,
                         )
             finally:
                 with self._rollback_lock:

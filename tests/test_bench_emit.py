@@ -53,6 +53,8 @@ def _bench_shaped_summary() -> dict:
         "failinj_rejoins": 12,
         "failinj_force_deletes": 12,
         "failinj_stuck_pod_cleared": True,
+        "failinj_ctrl_kills": 1,
+        "failinj_ctrl_recovery_ticks": 12,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,

@@ -16,6 +16,7 @@ import pytest
 
 from k8s_operator_libs_tpu.api import (
     DrainSpec,
+    EvictionEscalationSpec,
     IntOrString,
     SliceQuarantineSpec,
     TPUUpgradePolicySpec,
@@ -687,3 +688,484 @@ def test_full_roll_converges_through_fault_schedule(tier):
         live = store.get_node(n.name, cached=False)
         assert not live.spec.unschedulable
         assert live.labels[keys.state_label] == "upgrade-done"
+
+
+# -- crash-safe controller: restart chaos harness ---------------------------
+
+
+class _CountingClient:
+    """Delegating proxy over a shared FakeCluster that counts mutating
+    verbs (and optionally reports each to a global timeline).
+
+    One instance per controller incarnation/replica: the crash and
+    failover tests freeze a dead incarnation's count at tear-down and
+    assert it never moves again — zero actions executed by a deposed
+    leader's orphaned workers."""
+
+    _MUTATING = (
+        "create", "update", "patch", "delete", "evict",
+        "set_node_unschedulable",
+    )
+
+    def __init__(self, store, on_mutation=None):
+        self._store = store
+        self._on_mutation = on_mutation
+        self.mutations = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._store, name)
+        if callable(attr) and name.startswith(self._MUTATING):
+            def counted(*args, __attr=attr, __name=name, **kwargs):
+                self.mutations += 1
+                if self._on_mutation is not None:
+                    self._on_mutation(__name)
+                return __attr(*args, **kwargs)
+
+            return counted
+        return attr
+
+
+class ControllerCrasher:
+    """In-process SIGKILL analogue for the upgrade engine.
+
+    ``kill()`` flips the incarnation's fence cell — every in-flight
+    async worker (drain ladder, slice eviction, rollback) abandons at
+    its next fence check exactly as if the process died mid-eviction —
+    joins the orphans, freezes their mutation count, and boots a FRESH
+    manager (new in-memory everything) against the same cluster.  The
+    new incarnation re-adopts durable state on its first tick, as the
+    real controller does on process start / leadership gain."""
+
+    def __init__(self, store, keys, policy):
+        self.store = store
+        self.keys = keys
+        self.policy = policy
+        self.term = 0
+        self.kills = []
+        self.adopt_summaries = []
+        self.dead = []  # (client, mutation count frozen at death)
+        self._spawn()
+
+    def _spawn(self):
+        self.term += 1
+        self.client = _CountingClient(self.store)
+        alive = {"up": True}
+        self._alive = alive
+        self.mgr = ClusterUpgradeStateManager(
+            self.client, keys=self.keys,
+            poll_interval_s=0.005, poll_timeout_s=2.0,
+        )
+        self.mgr.fence = lambda a=alive: a["up"]
+        self._adopted = False
+
+    def kill(self, style):
+        self._alive["up"] = False           # the fence goes dark ...
+        self.mgr.wait_for_async_work(10.0)  # ... orphans abandon and join
+        self.dead.append((self.client, self.client.mutations))
+        self.kills.append(style)
+        self._spawn()
+
+    def tick(self, kill=None, wait=True):
+        """One reconcile pass.  ``kill='pre-apply'`` crashes after the
+        snapshot, ``kill='post-apply'`` crashes right after apply with
+        async workers still in flight; ``wait=False`` returns with the
+        async work running (so the caller can kill mid-ladder)."""
+        mgr = self.mgr
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, self.policy)
+        if not self._adopted:
+            self.adopt_summaries.append(mgr.adopt(
+                state, identity=f"crasher-{self.term}", term=self.term))
+            self._adopted = True
+        if kill == "pre-apply":
+            self.kill(kill)
+            return
+        mgr.apply_state(state, self.policy)
+        if kill == "post-apply":
+            self.kill(kill)
+            return
+        if wait:
+            mgr.wait_for_async_work(10.0)
+
+
+def test_crash_restart_chaos_multi_slice_roll():
+    """The crash-safe tentpole's acceptance scenario: a 3-slice roll
+    with an eviction ladder in flight (PDB-blocked, finalizer-held
+    workload pod) and a mid-roll quarantine, killed and rebuilt at 10+
+    randomized points — tick boundaries AND mid-tick — including forced
+    kills mid-escalation and mid-quarantine-dwell.  The roll must
+    converge with the slice-unit budget intact every tick, ladders
+    resuming at their persisted rung (not rung 0), every transition a
+    documented edge, and zero actions from any dead incarnation."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.k8s.client import NotFoundError
+    from k8s_operator_libs_tpu.k8s.drain import (
+        RUNG_DELETE,
+        RUNG_FORCE_DELETE,
+    )
+    from tests.test_state_diagram import EDGES, _TransitionRecorder
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(store, keys)
+    slices = _sliced_upgrade_scenario(store, keys, slices=3, hosts=2)
+    nodes = [n for ns in slices.values() for n in ns]
+    fx = ClusterFixture(store, keys)
+    # A workload pod whose eviction a PDB rejects and whose deletion a
+    # finalizer holds: the drain must climb the full ladder, leaving a
+    # persisted rung for the forced mid-escalation kill to land on.
+    sticky_node = slices["pool-0"][0]
+    sticky = fx.workload_pod(sticky_node, name="sticky-wl")
+    store.set_eviction_blocked(sticky.namespace, sticky.name, True)
+    store.set_pod_finalizers(sticky.namespace, sticky.name, ["test/hold"])
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(
+            enable=True, timeout_second=10, force=True,
+            eviction_escalation=EvictionEscalationSpec(
+                enable=True, evict_timeout_second=0,
+                delete_timeout_second=1, allow_force_delete=True,
+            ),
+        ),
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=1
+        ),
+    )
+    crasher = ControllerCrasher(store, keys, policy)
+    rng = random.Random(1337)
+    rung_key = keys.eviction_rung_annotation
+    in_flight_states = {
+        "cordon-required", "wait-for-jobs-required",
+        "pod-deletion-required", "drain-required",
+    }
+
+    def member_states(name):
+        return {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[name]
+        }
+
+    victim = None
+    healed = False
+    killed_mid_escalation = False
+    killed_mid_dwell = False
+    states = set()
+    for tick in range(800):
+        quarantined = {
+            name for name in slices if "quarantined" in member_states(name)
+        }
+        # Forced kill #1: pool-0's drain is about to run with the sticky
+        # pod on board.  Apply without waiting, poll the durable record
+        # until the ladder has climbed past evict, then kill with the
+        # drain worker mid-flight — the successor must resume at the
+        # persisted rung.
+        if (
+            not killed_mid_escalation
+            and "drain-required" in member_states("pool-0")
+        ):
+            crasher.tick(wait=False)
+            deadline = _time.monotonic() + 5.0
+            rung = None
+            while _time.monotonic() < deadline:
+                rung = store.get_node(
+                    sticky_node.name, cached=False
+                ).annotations.get(rung_key)
+                if rung in (RUNG_DELETE, RUNG_FORCE_DELETE):
+                    break
+                _time.sleep(0.005)
+            assert rung in (RUNG_DELETE, RUNG_FORCE_DELETE), (
+                f"ladder never climbed past evict (rung={rung!r})"
+            )
+            crasher.kill("mid-escalation")
+            killed_mid_escalation = True
+            continue
+        # Forced kill #2: the victim slice is parked and healed — its
+        # ready-dwell clock is running.  Kill mid-dwell; the successor
+        # must resume the dwell from the persisted stamp, not re-park
+        # or instantly rejoin.
+        if healed and quarantined and not killed_mid_dwell:
+            crasher.kill("mid-dwell")
+            killed_mid_dwell = True
+        kill = None
+        if len(crasher.kills) < 12 and tick % 3 == 2:
+            kill = ("boundary", "pre-apply", "post-apply")[tick // 3 % 3]
+        elif rng.random() < 0.03:
+            kill = rng.choice(("boundary", "pre-apply", "post-apply"))
+        if kill == "boundary":
+            crasher.kill("boundary")
+            kill = None
+        crasher.tick(kill=kill)
+        if victim is None:
+            # Strike the first slice AFTER pool-0 that enters the roll,
+            # mid-flight (pool-0 carries the escalation scenario).
+            for name in sorted(set(slices) - {"pool-0"}):
+                if member_states(name) & in_flight_states:
+                    victim = (name, f"{name}-w1")
+                    store.fault_schedule = FaultSchedule().node_down(
+                        victim[1], max_hits=1
+                    )
+                    break
+        quarantined = {
+            name for name in slices if "quarantined" in member_states(name)
+        }
+        if quarantined and not healed:
+            # Hardware comes back; the 1 s ready-dwell starts counting.
+            store.fault_schedule.clear()
+            store.set_node_ready(victim[1], True)
+            healed = True
+        # Per-tick budget invariant: non-quarantined slices with a
+        # cordoned host never exceed maxUnavailable=1 slice unit,
+        # across every crash and re-adoption.
+        down = {
+            name
+            for name, ns_ in slices.items()
+            if name not in quarantined
+            and any(
+                store.get_node(n.name, cached=False).spec.unschedulable
+                for n in ns_
+            )
+        }
+        assert len(down) <= 1, (
+            f"tick {tick}: budget exceeded: {sorted(down)}"
+        )
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+        if healed and quarantined:
+            _time.sleep(0.01)  # let the ready-dwell clock elapse
+    else:
+        pytest.fail(f"never converged: {sorted(states)}")
+
+    # The chaos really happened, at every kind of point.
+    assert len(crasher.kills) >= 10, crasher.kills
+    assert {"boundary", "pre-apply", "post-apply"} <= set(crasher.kills)
+    assert killed_mid_escalation and killed_mid_dwell
+    assert victim is not None
+    # At least one successor adopted a mid-flight ladder from the
+    # durable record (resumed at its persisted rung, not rung 0).
+    assert any(s["rungs"] > 0 for s in crasher.adopt_summaries), (
+        crasher.adopt_summaries
+    )
+    # Zero actions by any dead incarnation: every frozen mutation count
+    # is final (orphaned workers fenced out, never raced the successor).
+    for i, (client, frozen) in enumerate(crasher.dead):
+        assert client.mutations == frozen, (
+            f"dead incarnation {i} mutated after its kill "
+            f"({client.mutations} != {frozen})"
+        )
+    # The sticky pod lost to the ladder (force-deleted through its
+    # finalizer), and its node's ladder record is spent.
+    with pytest.raises(NotFoundError):
+        store.get_pod(sticky.namespace, sticky.name)
+    assert store.get_node(
+        sticky_node.name, cached=False
+    ).annotations.get(rung_key) is None
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, f"undocumented transitions: {undocumented}"
+    for n in nodes:
+        live = store.get_node(n.name, cached=False)
+        assert not live.spec.unschedulable
+        assert live.labels[keys.state_label] == "upgrade-done"
+
+
+def test_leader_failover_lease_expiry_mid_roll():
+    """Two replicas; the leader's lease renewals start failing mid-roll
+    so its term EXPIRES (no clean release — the crash case).  The
+    standby must take over with a bumped term, re-adopt, and finish the
+    roll; the deposed replica must execute ZERO mutations after the
+    successor's first (the renew-deadline < lease-duration gap)."""
+    import threading
+    import time as _time
+
+    from k8s_operator_libs_tpu.controller import (
+        ControllerConfig,
+        UpgradeController,
+    )
+    from k8s_operator_libs_tpu.k8s.leader import (
+        LeaderElector,
+        ensure_lease_kind,
+    )
+    from tests.test_upgrade_state import FakeProber
+
+    store = FakeCluster()
+    ensure_lease_kind(store)
+    keys = UpgradeKeys(driver_name="libtpu")
+    nodes = _upgrade_scenario(store, keys)
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    timeline = []  # (identity, verb), global order; appends are atomic
+    break_renewals = threading.Event()
+
+    def make(identity):
+        client = _CountingClient(
+            store,
+            on_mutation=lambda verb, i=identity: timeline.append((i, verb)),
+        )
+        c = UpgradeController(
+            client,
+            ControllerConfig(
+                namespace=NAMESPACE,
+                driver_labels=DRIVER_LABELS,
+                driver_name="libtpu",
+                interval_s=0.02,
+                policy=policy,
+                leader_elect=True,
+                identity=identity,
+                publish_events=False,
+            ),
+        )
+        # A SHORT renew deadline (vs the lease duration): the deposed
+        # leader must stand down after ~0.15 s of failed renewals, long
+        # before the roll can finish — the successor has to drive the
+        # bulk of it after taking over at lease expiry (0.8 s).
+        elector = LeaderElector(
+            store, identity=identity, namespace=NAMESPACE,
+            lease_duration_s=0.8, renew_deadline_s=0.15,
+            retry_period_s=0.05,
+        )
+        if identity == "old-leader":
+            orig = elector._try_acquire_or_renew
+
+            def breakable():
+                if break_renewals.is_set():
+                    raise RuntimeError("injected: apiserver unreachable")
+                return orig()
+
+            elector._try_acquire_or_renew = breakable
+        # The controller's fence reads self.elector at call time, so the
+        # swap re-points it too.
+        c.elector = elector
+        c.manager.validation_manager.prober = FakeProber()
+        c.manager.provider.poll_interval_s = 0.01
+        c.manager.provider.poll_timeout_s = 2.0
+        return c
+
+    c1, c2 = make("old-leader"), make("new-leader")
+    t1 = threading.Thread(target=c1.run_forever, daemon=True)
+    t2 = threading.Thread(target=c2.run_forever, daemon=True)
+    t1.start()
+    # Break at the EARLIEST in-flight stage: the deposed leader's short
+    # grace window then covers at most the first slice's opening moves,
+    # leaving the rest of the roll to the successor.
+    in_flight = {"cordon-required", "wait-for-jobs-required"}
+    try:
+        # Let replica 1 win cleanly, then bring up the standby.
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if c1.elector.is_leader():
+                break
+            _time.sleep(0.01)
+        assert c1.elector.is_leader(), "replica 1 never acquired"
+        t2.start()
+        # Wait until the roll is demonstrably in flight under replica 1.
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            labels = {
+                store.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for n in nodes
+            }
+            if labels & in_flight and any(
+                i == "old-leader" for i, _ in timeline
+            ):
+                break
+            _time.sleep(0.01)
+        assert labels & in_flight, f"roll never started: {labels}"
+        # The leader's apiserver connection "dies": renewals fail from
+        # here on, the lease expires, the standby takes over.
+        break_renewals.set()
+        deadline = _time.monotonic() + 120
+        states = {}
+        while _time.monotonic() < deadline:
+            states = {
+                n.name: store.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for n in nodes
+            }
+            if all(s == "upgrade-done" for s in states.values()):
+                break
+            _time.sleep(0.05)
+        else:
+            pytest.fail(f"failover roll never converged: {states}")
+    finally:
+        c1.stop()
+        c2.stop()
+        t1.join(10.0)
+        t2.join(10.0)
+    assert not t1.is_alive() and not t2.is_alive()
+    # The successor's term is a real takeover (leaseTransitions bumped),
+    # and it ran a re-adoption pass on gaining the lease.
+    assert c1.elector.term == 0
+    assert c2.elector.term >= 1
+    assert c2._adoptions >= 1
+    # Fencing: once the successor acted, the deposed leader never did.
+    snapshot = list(timeline)
+    first_new = next(
+        i for i, (who, _) in enumerate(snapshot) if who == "new-leader"
+    )
+    stale = [
+        (i, verb)
+        for i, (who, verb) in enumerate(snapshot)
+        if who == "old-leader" and i > first_new
+    ]
+    assert not stale, f"deposed leader acted after failover: {stale}"
+    assert any(who == "old-leader" for who, _ in snapshot[:first_new])
+
+
+def test_drain_resumes_at_persisted_rung_without_reevicting():
+    """Unit view of the durable ladder: a controller killed after
+    committing to the ``delete`` rung must resume THERE — the successor
+    never re-evicts (rung 0) a pod the old leader already escalated
+    past, and the spent record is cleared once the pod is gone."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.k8s.client import NotFoundError
+    from k8s_operator_libs_tpu.k8s.drain import (
+        RUNG_DELETE,
+        DrainHelper,
+        EscalationConfig,
+    )
+    from k8s_operator_libs_tpu.upgrade.durable import AnnotationRungStore
+
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    node = fx.tpu_slice("resume-pool", hosts=1, topology="2x2x1")[0]
+    pod = fx.workload_pod(node, name="survivor")
+    store = AnnotationRungStore(cluster, keys)
+    store.save(node.name, RUNG_DELETE, int(_time.time()) - 1)
+    evictions = []
+    orig_evict = cluster.evict_pod
+
+    def counting_evict(ns, name):
+        evictions.append(name)
+        return orig_evict(ns, name)
+
+    cluster.evict_pod = counting_evict
+    helper = DrainHelper(
+        cluster, force=True, timeout_s=5.0, poll_interval_s=0.01,
+        escalation=EscalationConfig(
+            enable=True, evict_timeout_s=30.0, delete_timeout_s=30.0,
+        ),
+        rung_store=store,
+    )
+    helper.delete_or_evict_pods([pod])
+    assert evictions == []  # resumed at delete, not rung 0
+    with pytest.raises(NotFoundError):
+        cluster.get_pod(pod.namespace, pod.name)
+    assert store.load(node.name) is None  # spent record cleared

@@ -340,3 +340,209 @@ def test_random_scenarios_hold_invariants(seed):
         # matched by a rejoin (the node loss may or may not have hit an
         # in-flight slice — both counts can legitimately be zero).
         assert mgr.rejoins_total == mgr.quarantines_total
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_crash_points_hold_invariants(seed):
+    """Crash-point fuzzing: the same randomized scenarios, but the
+    controller is killed and rebuilt at random ticks mid-roll (fence
+    flipped so orphaned workers abandon, fresh manager, re-adoption on
+    its first pass — each rebuild is a new leader term).  Every tick
+    must hold the slice-unit budget; no node may ever move BACKWARD in
+    ``STATE_ORDER`` except through the documented FAILED/QUARANTINED
+    recovery paths (in particular once ``upgrade-done``, always done);
+    and no pod is force-deleted in two different leader terms — the
+    persisted ladder rung makes the successor resume, not replay."""
+    from k8s_operator_libs_tpu.api import EvictionEscalationSpec
+    from k8s_operator_libs_tpu.upgrade import STATE_ORDER
+    from k8s_operator_libs_tpu.upgrade.consts import UpgradeState, parse_state
+
+    (
+        cluster,
+        keys,
+        mgr,
+        recorder,
+        slices,
+        policy,
+        fault,
+        node_fault,
+        budget,
+        dcn,
+        ring_of,
+    ) = _build_scenario(seed)
+    # Give the drain a full ladder (tracked below) and the fault plan's
+    # PDB-blocked pod a finalizer, so escalation commits durable rungs
+    # for the rebuilt controllers to resume.
+    policy.drain_spec.eviction_escalation = EvictionEscalationSpec(
+        enable=True, evict_timeout_second=0, delete_timeout_second=0,
+        allow_force_delete=True,
+    )
+    if fault:
+        cluster.set_pod_finalizers(NAMESPACE, fault["pod"], ["fuzz/hold"])
+    engine_client = mgr.client
+    gate = mgr.validation_manager.prober
+
+    # STATE_ORDER regression guard, checked at the patch site: backward
+    # movement is legal only out of FAILED/QUARANTINED (order >= 100).
+    regressions: list[tuple[str, str, str]] = []
+    orig_patch = cluster.patch_node_labels
+
+    def guarded_patch(name, patch):
+        if keys.state_label in patch:
+            old = parse_state(
+                cluster.get_node(name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+            )
+            new = parse_state(patch[keys.state_label] or "")
+            if (
+                STATE_ORDER[new] < STATE_ORDER[old]
+                and STATE_ORDER[old] < 100
+            ) or (old is UpgradeState.DONE and new is not UpgradeState.DONE):
+                regressions.append((name, old.value, new.value))
+        return orig_patch(name, patch)
+
+    cluster.patch_node_labels = guarded_patch
+
+    # Force-delete ledger, tagged with the leader term that issued it.
+    term_box = {"term": 1}
+    force_deletes: list[tuple[int, str, str]] = []
+    orig_delete = cluster.delete_pod
+
+    def tracked_delete(namespace, name, grace_period_seconds=None):
+        if grace_period_seconds == 0:
+            force_deletes.append((term_box["term"], namespace, name))
+        return orig_delete(
+            namespace, name, grace_period_seconds=grace_period_seconds
+        )
+
+    cluster.delete_pod = tracked_delete
+
+    def configure(m, alive):
+        m.recovery_probe_backoff_s = 0.0
+        m.validation_manager.rollback_drain_timeout_s = 0.2
+        m.validation_manager.rollback_poll_interval_s = 0.02
+        m.validation_manager.rollback_retry_backoff_s = 0.0
+        m.fence = lambda a=alive: a["up"]
+
+    alive = {"up": True}
+    configure(mgr, alive)
+    needs_adoption = True
+    kills = 0
+
+    def crash_and_rebuild():
+        nonlocal mgr, alive, needs_adoption, kills
+        alive["up"] = False              # SIGKILL analogue: fence dark
+        mgr.wait_for_async_work(30.0)    # orphans abandon and join
+        alive = {"up": True}
+        term_box["term"] += 1
+        mgr = ClusterUpgradeStateManager(
+            engine_client, keys=keys,
+            poll_interval_s=0.005, poll_timeout_s=2.0,
+        ).with_validation_enabled(gate)
+        configure(mgr, alive)
+        needs_adoption = True
+        kills += 1
+
+    crash_rng = random.Random(seed ^ 0xC0FFEE)
+    max_unavail_seen = 0
+    states: set = set()
+    for tick in range(400):
+        # Random kill points, plus deterministic early ones so every
+        # seed crashes at least while the roll is young.
+        if tick in (4, 9, 15) or (tick > 0 and crash_rng.random() < 0.06):
+            crash_and_rebuild()
+        try:
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            if needs_adoption:
+                mgr.adopt(
+                    state, identity=f"fuzz-{seed}", term=term_box["term"]
+                )
+                needs_adoption = False
+            mgr.apply_state(state, policy)
+        except NotFoundError:
+            time.sleep(0.05)
+            continue
+        except RuntimeError:
+            pass  # injected API fault outlived the retries: requeue
+        finally:
+            assert mgr.wait_for_async_work(30.0)
+
+        down = set()
+        for name, nodes in slices.items():
+            live = [cluster.get_node(n.name, cached=False) for n in nodes]
+            if any(
+                n.labels.get(keys.state_label) == "quarantined"
+                for n in live
+            ):
+                continue
+            if any(n.spec.unschedulable for n in live):
+                down.add(name)
+        max_unavail_seen = max(max_unavail_seen, len(down))
+        assert len(down) <= budget, (
+            f"seed {seed} tick {tick}: {len(down)} slices unavailable "
+            f"({sorted(down)}) > slice-unit budget {budget}"
+        )
+
+        if fault and not fault["healed"] and tick >= fault["heal_tick"]:
+            cluster.set_eviction_blocked(NAMESPACE, fault["pod"], False)
+            for n in slices[fault["slice"]]:
+                try:
+                    cluster.delete_pod(NAMESPACE, f"driver-{n.name}")
+                except NotFoundError:
+                    pass
+            fault["healed"] = True
+        if (
+            node_fault
+            and not node_fault["down"]
+            and tick >= node_fault["down_tick"]
+        ):
+            schedule = cluster.fault_schedule or FaultSchedule(seed=seed)
+            schedule.node_down(node_fault["node"], max_hits=1)
+            cluster.fault_schedule = schedule
+            node_fault["down"] = True
+        if (
+            node_fault
+            and node_fault["down"]
+            and not node_fault["healed"]
+            and tick >= node_fault["heal_tick"]
+        ):
+            if cluster.fault_schedule is not None:
+                cluster.fault_schedule.clear()
+            cluster.set_node_ready(node_fault["node"], True)
+            node_fault["healed"] = True
+
+        states = {
+            cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for nodes in slices.values()
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+    else:
+        pytest.fail(
+            f"seed {seed}: no convergence in 400 ticks with {kills} "
+            f"crashes (states {sorted(states)})"
+        )
+
+    assert kills >= 3
+    assert not regressions, (
+        f"seed {seed}: STATE_ORDER regressions {regressions}"
+    )
+    # No pod force-deleted under two different leader terms: the rung
+    # record is consumed exactly once across crash/rebuild boundaries.
+    terms_by_pod: dict[tuple[str, str], set[int]] = {}
+    for term, ns, name in force_deletes:
+        terms_by_pod.setdefault((ns, name), set()).add(term)
+    dupes = {k: v for k, v in terms_by_pod.items() if len(v) > 1}
+    assert not dupes, (
+        f"seed {seed}: force-deleted across terms: {dupes}"
+    )
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, (
+        f"seed {seed}: undocumented transitions {undocumented}"
+    )
+    assert max_unavail_seen >= 1
+    assert recorder.observed

@@ -239,13 +239,20 @@ def test_bookmarks_keep_quiet_kind_resume_points_fresh(small_cache_tier):
     # Churn Nodes well past the cache; the Pod stream stays quiet.
     for i in range(12):
         store.patch_node_labels("bk-0", {"churn": str(i)})
+    # Bookmarks trail the churn: an early one can be emitted (and read)
+    # while the cache is still rotating past it, so drain until the
+    # resume point catches up to the post-churn RV — the contract is
+    # that bookmarks KEEP ARRIVING, each one fresher.
     bookmark = None
+    churned = store.current_resource_version()
     deadline = time.monotonic() + 10.0
     for ev in gen:
         if ev is not None and ev.type == "BOOKMARK":
+            assert bookmark is None or ev.rv >= bookmark.rv
             bookmark = ev
-            break
-        assert time.monotonic() < deadline, "no BOOKMARK within 10s"
+            if bookmark.rv >= churned:
+                break
+        assert time.monotonic() < deadline, "no fresh BOOKMARK within 10s"
     gen.close()
     assert bookmark.object is None
     assert bookmark.rv > baseline
