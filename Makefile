@@ -12,8 +12,8 @@ TAG ?= latest
 BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
 .PHONY: all test test-fast chaos lint typecheck cov-report bench \
-	graft-check clean generate generate-check docker-build docker-push \
-	.build-image
+	bench-guard graft-check clean generate generate-check docker-build \
+	docker-push .build-image
 
 all: lint test
 
@@ -90,6 +90,12 @@ cov-report:
 
 bench:
 	$(PYTHON) bench.py
+
+# Hot-path regression gate: steady-state cached reconcile at 256 nodes
+# must stay under the pinned api_requests_per_tick ceiling (the
+# informer serves every read; see tools/bench_guard.py).
+bench-guard:
+	$(PYTHON) tools/bench_guard.py
 
 graft-check:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \

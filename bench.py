@@ -1322,6 +1322,17 @@ def main() -> None:
     beat()
     log(f"canary device-sustained perf: {device_perf}")
 
+    # -- cached reconcile hot path (informer; gated by `make bench-guard`) ---
+    # Steady-state ticks over a 256-node pool through the informer-backed
+    # cached client: api_requests_per_tick must stay ~0 (no relists, no
+    # per-node GETs).  Same measurement the bench-guard target enforces.
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    from bench_guard import measure as measure_cached_reconcile  # noqa: E402
+
+    cached_reconcile = measure_cached_reconcile()
+    beat()
+    log(f"cached reconcile (256-node steady state): {cached_reconcile}")
+
     complete = seq_result["complete"]
     details = {
         "complete": complete,
@@ -1369,6 +1380,7 @@ def main() -> None:
             "collective": dcn_collective,
         },
         "failure_injection": failinj,
+        "cached_reconcile": cached_reconcile,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1441,6 +1453,8 @@ def main() -> None:
         "failinj_ctrl_recovery_ticks": failinj["controller_kill"][
             "recovery_ticks"
         ],
+        "cached_api_per_tick": cached_reconcile["api_requests_per_tick"],
+        "cached_api_ceiling": cached_reconcile["ceiling_per_tick"],
         "mxu_tflops": _num(mxu.get("tflops"), 1),
         "mxu_mfu": _num(mxu.get("mfu"), 3),
         "hbm_gbps": _num(hbm.get("gbps"), 1),

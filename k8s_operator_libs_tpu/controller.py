@@ -120,8 +120,27 @@ class UpgradeController:
         self.config = config
         self.keys = UpgradeKeys(driver_name=config.driver_name)
         self.events = EventRecorder()
+        # Informer-backed cached reconcile (watch mode only): the watch
+        # pump doubles as the informer's event feed, and the manager
+        # reads through a CachedKubeClient so steady-state passes serve
+        # nodes/pods/daemonsets/revisions from the cache instead of
+        # re-listing.  Polling mode keeps the raw client: with no event
+        # stream the cache would always be stale and every read would
+        # fall through anyway.  self.client stays raw — leases, the
+        # watch pump's own lists and the quorum fences must not be
+        # cache-served.
+        self.informer = None
+        manager_client = client
+        if config.watch:
+            from k8s_operator_libs_tpu.k8s.informer import (
+                CachedKubeClient,
+                Informer,
+            )
+
+            self.informer = Informer(client)
+            manager_client = CachedKubeClient(client, informer=self.informer)
         self.manager = ClusterUpgradeStateManager(
-            client, keys=self.keys, event_recorder=self.events
+            manager_client, keys=self.keys, event_recorder=self.events
         )
         # TPU health gate: per-host probe-agent reports aggregated per
         # slice, pinned to the current driver revision.  The HBM floor is
@@ -177,6 +196,18 @@ class UpgradeController:
             # embedders may swap the elector after construction.
             self.manager.fence = (
                 lambda: self.elector is None or self.elector.is_leader()
+            )
+            # Term fence on top: workers quorum-read the persisted
+            # adoption stamp at entry/barriers and abandon if a HIGHER
+            # term has stamped their nodes — closes the renew-deadline
+            # window without waiting out any clock.  Built on the raw
+            # client: the whole point is a quorum read.
+            from k8s_operator_libs_tpu.upgrade.durable import make_term_fence
+
+            self.manager.term_fence = make_term_fence(
+                client,
+                self.keys,
+                lambda: self.elector.term if self.elector is not None else 0,
             )
         self._stop = False
         # Re-adoption: the first reconcile pass of every leadership epoch
@@ -709,7 +740,10 @@ class UpgradeController:
         return False
 
     def _watch_kinds(self) -> list[str]:
-        kinds = ["Node", "Pod", "DaemonSet"]
+        # ControllerRevision rides along because the steady-state pass
+        # resolves the driver DS's revision hash every tick — without
+        # caching it, that one lookup would keep a per-tick LIST alive.
+        kinds = ["Node", "Pod", "DaemonSet", "ControllerRevision"]
         if self.config.policy_ref is not None:
             from k8s_operator_libs_tpu.api.schema import (
                 POLICY_GROUP,
@@ -760,12 +794,19 @@ class UpgradeController:
                 if resume_rv is None:
                     # Baseline: the cluster RV "now" (shared across
                     # kinds — one etcd-style sequence), so the watch
-                    # below misses nothing after this instant.
-                    resume_rv = int(
-                        self.client.list_page("Node", limit=1)[
-                            "resourceVersion"
-                        ]
-                    )
+                    # below misses nothing after this instant.  With an
+                    # informer this is its LIST phase: sync() takes the
+                    # same one-item baseline first, then snapshots every
+                    # tracked kind, so the cache is coherent as of the
+                    # rv the watch resumes from.
+                    if self.informer is not None:
+                        resume_rv = self.informer.sync()
+                    else:
+                        resume_rv = int(
+                            self.client.list_page("Node", limit=1)[
+                                "resourceVersion"
+                            ]
+                        )
                 floors = {
                     (k.split("/")[-1] if "/" in k else k): resume_rv
                     for k in kinds
@@ -775,9 +816,18 @@ class UpgradeController:
                 ):
                     if self._stop:
                         return
+                    if self.informer is not None:
+                        # Every yield feeds the cache: deltas apply,
+                        # BOOKMARKs and None heartbeats refresh the
+                        # staleness clock (a quiet-but-connected stream
+                        # keeps cached reads valid).
+                        self.informer.handle_event(ev)
                     if gate is not None and not gate.is_set():
                         # Lost leadership: drop the streams; keep the
                         # floors so regaining replays the standby gap.
+                        # The informer is NOT invalidated — its age just
+                        # grows unfed, so cached reads degrade to
+                        # passthrough on their own.
                         resume_rv = min(floors.values())
                         break
                     if ev is not None:
@@ -799,11 +849,18 @@ class UpgradeController:
                 # otherwise resurrect it after a transient baseline-list
                 # failure, forcing a guaranteed second 410/re-list cycle.
                 floors = {}
+                if self.informer is not None:
+                    # The cache may have missed compacted deltas: mark it
+                    # unsynced so reads pass through until the relist
+                    # (the next sync() above) rebuilds it.
+                    self.informer.invalidate()
                 wake.set()
             except Exception as e:  # noqa: BLE001 — reconnect, don't die
                 logger.warning("watch stream broke (%s); reconnecting", e)
                 if floors:
                     resume_rv = min(floors.values())
+                if self.informer is not None:
+                    self.informer.stats["watch_reconnects"] += 1
                 time.sleep(1.0)
 
     def run_forever(self) -> None:

@@ -43,6 +43,11 @@ from k8s_operator_libs_tpu.k8s.faults import (  # noqa: F401
     FaultRule,
     FaultSchedule,
 )
+from k8s_operator_libs_tpu.k8s.informer import (  # noqa: F401
+    CachedKubeClient,
+    Informer,
+    InformerSnapshot,
+)
 from k8s_operator_libs_tpu.k8s.retry import (  # noqa: F401
     CircuitBreaker,
     CircuitOpenError,

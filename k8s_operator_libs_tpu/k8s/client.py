@@ -485,10 +485,24 @@ class FakeCluster:
             self._nodes.put(node.name, node)
             return deep_copy(node)
 
-    def get_node(self, name: str, cached: bool = True) -> Node:
+    def get_node(
+        self,
+        name: str,
+        cached: bool = True,
+        max_staleness_s: Optional[float] = None,
+    ) -> Node:
         """Read a node. ``cached=True`` models the controller-runtime cache
-        (subject to cache lag); ``cached=False`` is a quorum read."""
+        (subject to cache lag); ``cached=False`` is a quorum read.  A
+        ``max_staleness_s`` bound tighter than the configured cache lag
+        upgrades the read to quorum — the staleness-guard contract for
+        reads that feed mutating decisions."""
         self._call("get_node")
+        if (
+            cached
+            and max_staleness_s is not None
+            and self.cache_lag_s > max_staleness_s
+        ):
+            cached = False
         with self._lock:
             obj = (
                 self._nodes.get_cached(name, self.cache_lag_s)

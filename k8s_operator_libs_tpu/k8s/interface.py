@@ -41,8 +41,21 @@ class KubeClient(Protocol):
 
     # -- nodes --------------------------------------------------------------
 
-    def get_node(self, name: str, cached: bool = True) -> Node:
-        """Read a node; ``cached=False`` is a quorum read."""
+    def get_node(
+        self,
+        name: str,
+        cached: bool = True,
+        max_staleness_s: Optional[float] = None,
+    ) -> Node:
+        """Read a node; ``cached=False`` is a quorum read.
+
+        ``max_staleness_s`` bounds how stale a ``cached=True`` read may
+        be: when the serving cache cannot prove it is within the bound,
+        the implementation upgrades the call to a quorum read.  Callers
+        whose result feeds a MUTATING decision (cordon, drain, fence
+        checks) should pass a bound so a lagging cache can never drive
+        an action off ancient state; pure convergence polls (the
+        write-then-poll cache waits) leave it None."""
         ...
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:

@@ -741,12 +741,18 @@ class RestClient:
 
     # -- nodes -------------------------------------------------------------
 
-    def get_node(self, name: str, cached: bool = True) -> Node:
-        # A REST read is always a quorum read; `cached` exists for
-        # interface parity with FakeCluster (controller-runtime's cache
-        # does not apply here, but the write-then-poll loop in
-        # NodeUpgradeStateProvider is still correct — it just converges
-        # on the first poll).
+    def get_node(
+        self,
+        name: str,
+        cached: bool = True,
+        max_staleness_s: Optional[float] = None,
+    ) -> Node:
+        # A REST read is always a quorum read; `cached` and
+        # `max_staleness_s` exist for interface parity with FakeCluster
+        # and CachedKubeClient (controller-runtime's cache does not
+        # apply here — every read trivially satisfies any staleness
+        # bound, and the write-then-poll loop in NodeUpgradeStateProvider
+        # converges on the first poll).
         return node_from_json(self._request("GET", f"/api/v1/nodes/{name}"))
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:

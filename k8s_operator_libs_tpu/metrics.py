@@ -185,6 +185,42 @@ class UpgradeMetrics:
             "api_breaker_fast_fails_total",
             "API calls fast-failed because the endpoint circuit was open",
         )
+        # Informer-backed cached reconcile surface (absent when the
+        # manager reads through a raw client, i.e. polling mode).
+        r.describe(
+            "api_requests_per_tick",
+            "API round trips issued during the last reconcile pass "
+            "(all verbs; ~0 at steady state with a warm cache)",
+        )
+        r.describe(
+            "informer_cache_hits_total",
+            "Hot-path reads served from the informer store",
+        )
+        r.describe(
+            "informer_cache_misses_total",
+            "Hot-path reads that fell through to the API (cold, stale, "
+            "or absent object)",
+        )
+        r.describe(
+            "informer_snapshot_age_seconds",
+            "Seconds since the informer feed last heard from the "
+            "apiserver (-1 = never synced)",
+        )
+        r.describe(
+            "informer_lists_total",
+            "Baseline LIST syncs the informer has performed",
+        )
+        r.describe(
+            "informer_watch_reconnects_total",
+            "Watch stream reconnects (resumed from the per-kind floor)",
+        )
+        r.describe(
+            "informer_relists_total",
+            "410-Gone invalidations that forced a full re-list",
+        )
+        # api_requests_per_tick baseline: total verb count at the end of
+        # the previous observe() call.
+        self._last_api_total: Optional[float] = None
 
     def observe(self, manager, state, duration_s: float) -> None:
         r = self.registry
@@ -240,6 +276,38 @@ class UpgradeMetrics:
             r.set(
                 "api_breaker_fast_fails_total",
                 retry_stats.get("breaker_fast_fail", 0),
+            )
+        # Cached-reconcile surface.  ``client.stats`` counts actual API
+        # round trips per verb (a CachedKubeClient delegates the attr to
+        # its inner client), so the delta across observe() calls is the
+        # API cost of the tick that just ran — the number the informer
+        # exists to drive to ~0 at steady state.
+        api_stats = getattr(client, "stats", None)
+        if api_stats is not None and hasattr(api_stats, "values"):
+            total = float(sum(api_stats.values()))
+            if self._last_api_total is not None:
+                r.set(
+                    "api_requests_per_tick", total - self._last_api_total
+                )
+            self._last_api_total = total
+        informer = getattr(client, "informer", None)
+        if informer is not None and hasattr(informer, "stats"):
+            istats = informer.stats
+            r.set("informer_cache_hits_total", istats.get("cache_hits", 0))
+            r.set(
+                "informer_cache_misses_total",
+                istats.get("cache_misses", 0),
+            )
+            r.set("informer_lists_total", istats.get("lists", 0))
+            r.set(
+                "informer_watch_reconnects_total",
+                istats.get("watch_reconnects", 0),
+            )
+            r.set("informer_relists_total", istats.get("relists_410", 0))
+            age = informer.age_s()
+            r.set(
+                "informer_snapshot_age_seconds",
+                age if age != float("inf") else -1.0,
             )
 
 

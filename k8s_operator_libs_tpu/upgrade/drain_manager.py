@@ -88,7 +88,10 @@ class DrainManager:
         # fence every async worker consults before mutating, and the
         # annotation-backed store that persists each node's eviction-
         # ladder rung so a fresh leader resumes mid-escalation.
+        # term_fence adds the adoption-stamp term check (quorum read,
+        # worker entry + group barrier only).
         self.fence = None
+        self.term_fence = None
         self.rung_store = None
         # Dedup of in-flight drains across reconcile passes
         # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
@@ -158,6 +161,10 @@ class DrainManager:
         try:
             if self.fence is not None and not self.fence():
                 return  # deposed leader: abandon without acting
+            if self.term_fence is not None and not self.term_fence(
+                group.nodes
+            ):
+                return  # a higher term already adopted these nodes
             helper = DrainHelper(
                 self.client,
                 force=spec.force,
@@ -224,7 +231,13 @@ class DrainManager:
 
             # Group barrier: all-or-nothing transition — fenced, so a
             # deposed leader's worker cannot flip the slice after handoff.
+            # The term fence re-checks here too: a successor elected
+            # mid-drain has stamped its term by the time we transition.
             if self.fence is not None and not self.fence():
+                return
+            if self.term_fence is not None and not self.term_fence(
+                group.nodes
+            ):
                 return
             if policy_failed:
                 self.last_error[group.id] = (

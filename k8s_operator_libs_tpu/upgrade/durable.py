@@ -115,6 +115,54 @@ class AnnotationRungStore:
             logger.debug("rung clear for %s failed: %s", node_name, e)
 
 
+def make_term_fence(client: KubeClient, keys: UpgradeKeys, term_source):
+    """Term-comparison fence on top of the liveness fence.
+
+    The liveness fence (lease renew deadline) leaves a theoretical
+    window: a deposed leader's in-flight worker can act between its last
+    successful renewal and the deadline, racing the successor.  The
+    successor's adoption pass stamps every in-flight group's nodes with
+    ``<identity>@<term>`` — so a worker that QUORUM-reads the stamp and
+    finds a term HIGHER than its own knows, without waiting out any
+    clock, that it has been deposed.
+
+    Returns a callable ``fence(nodes) -> bool``: False means a
+    higher-term leader has adopted at least one of the nodes and the
+    worker must abandon quietly.  Checked once at worker ENTRY and at
+    group barriers — not inside polling loops — so the quorum reads it
+    costs stay off the steady-state hot path.  Fail-open on read errors
+    (the liveness fence and idempotent passes remain the backstop; a
+    fence that fails closed would wedge workers on API blips)."""
+
+    def fence(nodes) -> bool:
+        try:
+            my_term = int(term_source())
+        except Exception:
+            return True
+        for node in nodes:
+            name = getattr(node, "name", node)
+            try:
+                live = client.get_node(name, cached=False)
+            except Exception:
+                continue
+            stamp = parse_adoption_stamp(
+                live.annotations.get(keys.adopted_by_annotation)
+            )
+            if stamp is not None and stamp[1] > my_term:
+                logger.warning(
+                    "term fence: node %s adopted by %s@%d > own term %d; "
+                    "abandoning",
+                    name,
+                    stamp[0],
+                    stamp[1],
+                    my_term,
+                )
+                return False
+        return True
+
+    return fence
+
+
 def format_adoption_stamp(identity: str, term: int) -> str:
     return f"{identity}@{term}"
 

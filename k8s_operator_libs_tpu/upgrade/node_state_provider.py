@@ -65,6 +65,7 @@ class NodeUpgradeStateProvider:
         poll_interval_s: float = 1.0,
         poll_timeout_s: float = 10.0,
         max_concurrency: int = 32,
+        max_staleness_s: float = 30.0,
     ) -> None:
         # Reference defaults: 1 s poll, 10 s timeout
         # (node_upgrade_state_provider.go:100-103).
@@ -74,13 +75,22 @@ class NodeUpgradeStateProvider:
         self.poll_interval_s = poll_interval_s
         self.poll_timeout_s = poll_timeout_s
         self.max_concurrency = max_concurrency
+        # Staleness guard for decision-feeding reads: build_state and
+        # the managers act on what get_node returns (cordon, drain,
+        # state transitions), so a cache older than this bound is
+        # upgraded to a quorum read by the client.  The write-then-poll
+        # waits below intentionally do NOT pass it — they are
+        # convergence polls and the whole point is to read the cache.
+        self.max_staleness_s = max_staleness_s
         self._node_mutex = KeyedMutex()
 
     # -- reads -------------------------------------------------------------
 
     def get_node(self, node_name: str) -> Node:
         with self._node_mutex.lock(node_name):
-            return self.client.get_node(node_name)
+            return self.client.get_node(
+                node_name, cached=True, max_staleness_s=self.max_staleness_s
+            )
 
     # -- single-node writes (reference parity) ------------------------------
 

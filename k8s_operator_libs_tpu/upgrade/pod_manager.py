@@ -103,7 +103,10 @@ class PodManager:
         self.escalation_stats = escalation_stats
         # Crash-safety hooks wired by the upgrade manager (see
         # drain_manager.py): leadership fence + durable rung store.
+        # term_fence adds the adoption-stamp term check (quorum read,
+        # worker entry only).
         self.fence = None
+        self.term_fence = None
         self.rung_store = None
         # Apiserver-facing poll cadence for eviction waits (kubectl-like
         # 1 s in production; tests pass the suite's fast interval).
@@ -222,6 +225,10 @@ class PodManager:
         try:
             if self.fence is not None and not self.fence():
                 return  # deposed leader: abandon without acting
+            if self.term_fence is not None and not self.term_fence(
+                group.nodes
+            ):
+                return  # a higher term already adopted these nodes
             helper = DrainHelper(
                 self.client,
                 force=spec.force,

@@ -222,6 +222,11 @@ class ClusterUpgradeStateManager:
         # async-worker owner, so a deposed leader's in-flight workers
         # abandon (FencedError) instead of mutating after handoff.
         self._fence = None
+        # Term-comparison fence on top of liveness (ROADMAP follow-up):
+        # workers compare the persisted adoption stamp's term against
+        # their own before mutating, closing the deposed-leader window
+        # between the last renew and the lease deadline.
+        self._term_fence = None
         self._pod_deletion_enabled = False
         self._validation_enabled = False
         # Failed-group recovery probes are rate-limited: with a local
@@ -305,6 +310,27 @@ class ClusterUpgradeStateManager:
         ):
             try:
                 mgr.fence = fn
+            except AttributeError:
+                pass  # injected fakes may refuse the attribute
+
+    @property
+    def term_fence(self):
+        """Term-comparison fence (``fence(nodes) -> bool``): False when a
+        HIGHER-term leader's adoption stamp is already persisted on one
+        of the nodes — the deposed-leader window the liveness fence
+        cannot close (see durable.make_term_fence)."""
+        return self._term_fence
+
+    @term_fence.setter
+    def term_fence(self, fn) -> None:
+        self._term_fence = fn
+        for mgr in (
+            self.drain_manager,
+            self.pod_manager,
+            self.validation_manager,
+        ):
+            try:
+                mgr.term_fence = fn
             except AttributeError:
                 pass  # injected fakes may refuse the attribute
 
@@ -426,13 +452,35 @@ class ClusterUpgradeStateManager:
         honor ``TPUUpgradePolicySpec.slice_atomic=False`` (every node a
         singleton group) and ``topology.hosts_per_slice`` overrides."""
         logger.info("building state")
-        daemon_sets = {
-            ds.metadata.uid: ds
-            for ds in self.client.list_daemon_sets(namespace, driver_labels)
-        }
-        pods = self.client.list_pods(
-            namespace=namespace, match_labels=driver_labels
-        )
+        # Informer fast path: when the client exposes a fresh coherent
+        # cache snapshot (CachedKubeClient), resolve daemonsets, pods,
+        # AND every pod's node from the SAME in-memory view — one lock
+        # hold, zero API round trips, no torn-read window between the
+        # list calls below.  Otherwise (raw client, stale/unsynced
+        # cache) the direct list + per-pod provider reads keep their
+        # exact semantics.
+        snapshot_fn = getattr(self.client, "coherent_snapshot", None)
+        snapshot = snapshot_fn() if callable(snapshot_fn) else None
+        if snapshot is not None:
+            daemon_sets = {
+                ds.metadata.uid: ds
+                for ds in snapshot.list_daemon_sets(
+                    namespace, driver_labels
+                )
+            }
+            pods = snapshot.list_pods(
+                namespace=namespace, match_labels=driver_labels
+            )
+        else:
+            daemon_sets = {
+                ds.metadata.uid: ds
+                for ds in self.client.list_daemon_sets(
+                    namespace, driver_labels
+                )
+            }
+            pods = self.client.list_pods(
+                namespace=namespace, match_labels=driver_labels
+            )
 
         filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
         for ds in daemon_sets.values():
@@ -457,9 +505,15 @@ class ClusterUpgradeStateManager:
             if not pod.spec.node_name:
                 logger.info("driver pod %s has no node, skipping", pod.name)
                 continue
-            try:
-                node = self.provider.get_node(pod.spec.node_name)
-            except NotFoundError:
+            node = None
+            if snapshot is not None:
+                node = snapshot.get_node(pod.spec.node_name)
+            else:
+                try:
+                    node = self.provider.get_node(pod.spec.node_name)
+                except NotFoundError:
+                    node = None
+            if node is None:
                 # Node deleted mid-roll (hardware repair, scale-down) with
                 # its driver pod still Terminating: the pod is not part of
                 # the cluster anymore.  Skipping it keeps the snapshot

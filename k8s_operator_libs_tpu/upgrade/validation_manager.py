@@ -157,8 +157,10 @@ class ValidationManager:
         self.rollback_attempts: dict[str, int] = {}
         # Crash-safety hooks wired by the upgrade manager: leadership
         # fence for the async rollback workers + durable rung store for
-        # their eviction ladders.
+        # their eviction ladders.  term_fence adds the adoption-stamp
+        # term check (quorum read, worker entry only).
         self.fence = None
+        self.term_fence = None
         self.rung_store = None
 
     # -- durable rollback clocks --------------------------------------------
@@ -343,6 +345,8 @@ class ValidationManager:
 
         if self.fence is not None and not self.fence():
             return  # deposed leader: the new leader re-adopts this work
+        if self.term_fence is not None and not self.term_fence(group.nodes):
+            return  # a higher term already adopted these nodes
         with self._rollback_lock:
             if group.id in self._rollback_active:
                 return  # a worker is already evicting this group

@@ -55,6 +55,8 @@ def _bench_shaped_summary() -> dict:
         "failinj_stuck_pod_cleared": True,
         "failinj_ctrl_kills": 1,
         "failinj_ctrl_recovery_ticks": 12,
+        "cached_api_per_tick": 123.456,
+        "cached_api_ceiling": 0.5,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,

@@ -1169,3 +1169,187 @@ def test_drain_resumes_at_persisted_rung_without_reevicting():
     with pytest.raises(NotFoundError):
         cluster.get_pod(pod.namespace, pod.name)
     assert store.load(node.name) is None  # spent record cleared
+
+
+# -- informer-backed cached reconcile under chaos ----------------------------
+
+
+def test_full_roll_converges_through_faults_with_cached_client():
+    """PR 1-3 resilience THROUGH the cache path: the same 429 storm /
+    503 window / dropped-watch schedule as the raw-client roll, but the
+    manager reads via CachedKubeClient and the informer's standalone
+    feed rides the faulted watch stream.  The roll must converge, the
+    informer must visibly reconnect through the drops, the retried
+    writes must flow through, and the final cache must agree with the
+    store object-for-object."""
+    from k8s_operator_libs_tpu.k8s import CachedKubeClient, Informer
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    slices = _sliced_upgrade_scenario(store, keys)
+    nodes = [n for ns in slices.values() for n in ns]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    retry_policy = RetryPolicy(
+        max_attempts=2, base_backoff_s=0.001, max_backoff_s=0.005,
+        jitter=0.0,
+    )
+    breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=0.03)
+    store.fault_schedule = (
+        FaultSchedule(seed=5)
+        .throttle("patch_node", retry_after_s=0.001, max_hits=8)
+        .server_error("list_nodes", status=503, skip=6, max_hits=6)
+        .watch_drop(max_hits=2)
+    )
+    resilient = ResilientClient(
+        store, retry_policy=retry_policy, breaker=breaker
+    )
+    # The informer feeds from the SAME faulted client the engine writes
+    # through: its baseline lists eat the 503 window, its watch stream
+    # eats the drops.
+    informer = Informer(resilient).start()
+    client = CachedKubeClient(resilient, informer=informer)
+    mgr = ClusterUpgradeStateManager(
+        client, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    try:
+        assert informer.wait_synced(10.0)
+        for tick in range(400):
+            try:
+                state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+                mgr.apply_state(state, policy)
+            except (BuildStateError, RuntimeError, OSError):
+                pass  # faulted pass: requeue, like a real reconciler
+            finally:
+                mgr.wait_for_async_work(10.0)
+            # Slice-unit budget, observed fault-free on the store.
+            down = {
+                name
+                for name, ns_ in slices.items()
+                if any(
+                    store.get_node(n.name, cached=False).spec.unschedulable
+                    for n in ns_
+                )
+            }
+            assert len(down) <= 1, (
+                f"tick {tick}: budget exceeded: {sorted(down)}"
+            )
+            states = {
+                store.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for n in nodes
+            }
+            if states == {"upgrade-done"}:
+                break
+        else:
+            pytest.fail(f"cached roll never converged: {sorted(states)}")
+    finally:
+        informer.stop()
+
+    # The chaos really flowed through the cache path.
+    assert resilient.retry_stats["retries"] >= 1
+    assert informer.stats["watch_reconnects"] >= 1
+    assert informer.stats["cache_hits"] >= 1
+    assert breaker.open_endpoints() == {}
+    # Cache/store agreement, object for object (labels carry the whole
+    # state machine, so label equality is state equality).
+    for n in nodes:
+        live = store.get_node(n.name, cached=False)
+        cached_view = informer.get_node(n.name)
+        assert cached_view is not None
+        assert cached_view.labels == live.labels
+        assert live.labels[keys.state_label] == "upgrade-done"
+
+
+def test_node_loss_quarantine_flows_through_cached_client():
+    """node_down/node_flap through the cache: the kubelet flap is a
+    store mutation, so it reaches the engine as a watch delta — the
+    slice parks in quarantined off CACHED reads, and after the fault
+    clears and the dwell passes the roll completes."""
+    from k8s_operator_libs_tpu.k8s import CachedKubeClient, Informer
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    slices = _sliced_upgrade_scenario(store, keys, slices=2, hosts=4)
+    nodes = [n for ns in slices.values() for n in ns]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=1
+        ),
+    )
+    informer = Informer(store).start()
+    client = CachedKubeClient(store, informer=informer)
+    mgr = ClusterUpgradeStateManager(
+        client, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    def member_states(name):
+        return {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[name]
+        }
+
+    in_flight = {
+        "cordon-required", "wait-for-jobs-required",
+        "pod-deletion-required", "drain-required",
+    }
+    victim = None
+    cleared = False
+    saw_quarantine = False
+    try:
+        assert informer.wait_synced(10.0)
+        for tick in range(600):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            if victim is None:
+                for name in sorted(slices):
+                    if member_states(name) & in_flight:
+                        victim = (name, f"{name}-w1")
+                        store.fault_schedule = FaultSchedule().node_down(
+                            victim[1], max_hits=1
+                        )
+                        break
+            quarantined = {
+                name
+                for name in slices
+                if "quarantined" in member_states(name)
+            }
+            if quarantined and not saw_quarantine:
+                saw_quarantine = True
+                assert quarantined == {victim[0]}
+            if saw_quarantine and not cleared:
+                store.fault_schedule.clear()
+                store.set_node_ready(victim[1], True)
+                cleared = True
+            states = {
+                store.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for n in nodes
+            }
+            if states == {"upgrade-done"}:
+                break
+        else:
+            pytest.fail(
+                f"quarantine roll through cache never converged: "
+                f"{sorted(states)}"
+            )
+    finally:
+        informer.stop()
+    assert saw_quarantine, "the node loss never parked the slice"
+    assert mgr.quarantines_total >= 1
+    assert mgr.rejoins_total >= 1

@@ -64,6 +64,24 @@ def test_fake_cluster_satisfies_runtime_protocol():
     assert isinstance(FakeCluster(), KubeClient)
 
 
+def test_cached_client_satisfies_runtime_protocol():
+    """The informer-backed wrapper is a drop-in KubeClient: overridden
+    hot-path reads keep protocol signatures, everything else delegates."""
+    from k8s_operator_libs_tpu.k8s import CachedKubeClient
+
+    wrapped = CachedKubeClient(FakeCluster())
+    assert isinstance(wrapped, KubeClient)
+    missing = [m for m in PROTOCOL_METHODS if not hasattr(wrapped, m)]
+    assert not missing, f"CachedKubeClient missing: {missing}"
+    # The staleness-guard signature must match the Protocol exactly on
+    # the override too (same drift class as the impl pins above).
+    want = inspect.signature(getattr(_Proto, "get_node"))
+    got = inspect.signature(CachedKubeClient.get_node)
+    assert [
+        (p.name, p.kind, p.default) for p in want.parameters.values()
+    ] == [(p.name, p.kind, p.default) for p in got.parameters.values()]
+
+
 def test_engine_is_annotated_against_the_protocol():
     from k8s_operator_libs_tpu.upgrade.upgrade_state import (
         ClusterUpgradeStateManager,

@@ -48,6 +48,7 @@ from k8s_operator_libs_tpu.upgrade import (
     ProbeResult,
     UpgradeKeys,
 )
+from k8s_operator_libs_tpu.upgrade.upgrade_state import BuildStateError
 from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
 from tests.test_state_diagram import EDGES, _TransitionRecorder
 
@@ -545,4 +546,124 @@ def test_random_crash_points_hold_invariants(seed):
         f"seed {seed}: undocumented transitions {undocumented}"
     )
     assert max_unavail_seen >= 1
+    assert recorder.observed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_watch_killed_mid_roll_cache_reconverges(seed):
+    """Cached-reconcile fuzz rule: the engine reads through the informer
+    while its watch feed is KILLED outright at random ticks mid-roll and
+    restarted a few ticks later.  While the feed is dead the cache ages
+    past its (tight) bound and degrades to passthrough; the restart
+    re-lists.  Either way no transition may be missed or undocumented,
+    the slice budget must hold every tick, and the final cache must
+    agree with the store node-for-node."""
+    from k8s_operator_libs_tpu.k8s import CachedKubeClient, Informer
+
+    rng = random.Random(1000 + seed)
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(cluster, keys)
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    n_slices = rng.randint(2, 4)
+    hosts = rng.choice([2, 4])
+    slices = {
+        f"pool-{i}": fx.tpu_slice(
+            f"pool-{i}", hosts=hosts,
+            topology={2: "2x2x2", 4: "2x2x4"}[hosts],
+        )
+        for i in range(n_slices)
+    }
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=rng.randint(1, 2),
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+
+    # Tight bound so the dead-feed window visibly crosses from
+    # serve-stale into passthrough during the test.
+    informer = Informer(cluster, max_staleness_s=0.5).start()
+    client = CachedKubeClient(cluster, informer=informer)
+    mgr = ClusterUpgradeStateManager(
+        client, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    kill_ticks = sorted(rng.sample(range(2, 25), k=2))
+    restart_at = None
+    states: set = set()
+    assert informer.wait_synced(10.0)
+    try:
+        for tick in range(300):
+            if restart_at is not None and tick >= restart_at:
+                informer.start()  # ops restarts the feed: full re-list
+                assert informer.wait_synced(10.0)
+                restart_at = None
+            elif kill_ticks and tick == kill_ticks[0]:
+                informer.stop()  # the feed dies mid-roll
+                restart_at = tick + rng.randint(2, 5)
+                kill_ticks.pop(0)
+            try:
+                state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            except BuildStateError:
+                # Torn snapshot: the informer thread applied a driver
+                # pod's DELETED event but not yet its recreation.  The
+                # controller skips such ticks too; the next one heals.
+                time.sleep(0.01)
+                continue
+            mgr.apply_state(state, policy)
+            assert mgr.wait_for_async_work(30.0)
+            down = {
+                name
+                for name, ns_ in slices.items()
+                if any(
+                    cluster.get_node(n.name, cached=False)
+                    .spec.unschedulable
+                    for n in ns_
+                )
+            }
+            assert len(down) <= 1, (
+                f"seed {seed} tick {tick}: budget exceeded {sorted(down)}"
+            )
+            states = {
+                cluster.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                for nodes in slices.values()
+                for n in nodes
+            }
+            if states == {"upgrade-done"}:
+                break
+        else:
+            pytest.fail(
+                f"seed {seed}: cached roll with killed watch never "
+                f"converged (states {sorted(states)})"
+            )
+        # Reconverge the cache (the feed may be down right now) and
+        # compare against the source of truth.
+        informer.start()
+        assert informer.wait_synced(10.0)
+        informer.sync()
+        for nodes in slices.values():
+            for n in nodes:
+                live = cluster.get_node(n.name, cached=False)
+                cached_view = informer.get_node(n.name)
+                assert cached_view is not None
+                assert cached_view.labels == live.labels
+    finally:
+        informer.stop()
+
+    # The kills really happened (restart re-listed at least once more).
+    assert informer.stats["lists"] >= 3
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, (
+        f"seed {seed}: undocumented transitions {undocumented}"
+    )
     assert recorder.observed

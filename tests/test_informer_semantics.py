@@ -19,12 +19,16 @@ import pytest
 
 from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
 from k8s_operator_libs_tpu.k8s import (
+    CachedKubeClient,
     ExpiredError,
     FakeCluster,
+    Informer,
     KubeApiServer,
     KubeConfig,
     RestClient,
 )
+from k8s_operator_libs_tpu.k8s.client import WatchEvent
+from k8s_operator_libs_tpu.k8s.faults import FaultSchedule
 from tests.fixtures import make_node
 
 
@@ -440,7 +444,239 @@ def test_watch_pump_recovers_from_410_by_relisting():
         assert client.calls[2] > baseline
         # The 410 forced a wake — the reconcile pass IS the re-list.
         assert wake.is_set()
+        # The pump-fed informer saw the 410 too: invalidated + relisted.
+        assert controller.informer is not None
+        assert controller.informer.stats["relists_410"] >= 1
     finally:
         controller.stop()
         t.join(5.0)
     assert not t.is_alive()
+
+
+# -- informer-backed cached reconcile -----------------------------------------
+
+
+def test_informer_lists_once_then_converges_on_watch_deltas(tier):
+    """The SharedInformer contract on both tiers: ONE baseline list,
+    then the store tracks the live cluster purely from watch deltas —
+    adds, label changes, and deletes all land without another list."""
+    store, client = tier.store, tier.client
+    store.create_node(make_node("inf-a", labels={"pool": "x"}))
+    informer = Informer(client).start()
+    try:
+        assert informer.wait_synced(5.0)
+        assert informer.get_node("inf-a").labels["pool"] == "x"
+        store.patch_node_labels("inf-a", {"pool": "y"})
+        store.create_node(make_node("inf-b"))
+        store.delete_node("inf-a")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (
+                informer.get_node("inf-a") is None
+                and informer.get_node("inf-b") is not None
+            ):
+                break
+            time.sleep(0.01)
+        assert informer.get_node("inf-a") is None
+        assert informer.get_node("inf-b") is not None
+        assert [n.name for n in informer.list_nodes()] == ["inf-b"]
+    finally:
+        informer.stop()
+    assert informer.stats["lists"] == 1, "deltas must not trigger re-lists"
+
+
+def test_informer_resumes_and_reconverges_after_watch_drops():
+    """Stream drops (apiserver restart / LB idle reset) are absorbed by
+    the min-floor resume: the feed reconnects, replays what it missed,
+    and the cache reconverges — still without a re-list."""
+    store = FakeCluster()
+    store.create_node(make_node("drop-0", labels={"gen": "0"}))
+    store.fault_schedule = FaultSchedule().watch_drop(max_hits=2)
+    informer = Informer(store).start()
+    try:
+        assert informer.wait_synced(5.0)
+        deadline = time.monotonic() + 10.0
+        gen = 0
+        while time.monotonic() < deadline:
+            if informer.stats["watch_reconnects"] >= 2:
+                break
+            gen += 1
+            store.patch_node_labels("drop-0", {"gen": str(gen)})
+            time.sleep(0.02)
+        assert informer.stats["watch_reconnects"] >= 2
+        final = str(gen)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            n = informer.get_node("drop-0")
+            if n is not None and n.labels.get("gen") == final:
+                break
+            time.sleep(0.01)
+        assert informer.get_node("drop-0").labels["gen"] == final
+    finally:
+        informer.stop()
+
+
+def test_informer_invalidates_on_410_and_relists(small_cache_tier):
+    """The compacted-resume-point path: a 410 marks the store unsynced
+    (reads fall through, no stale serving), and the next sync() re-list
+    rebuilds a fresh coherent cache."""
+    store, client = small_cache_tier.store, small_cache_tier.client
+    store.create_node(make_node("gone-0"))
+    informer = Informer(client)
+    rv = informer.sync()
+    assert informer.fresh()
+    # Push the resume point out of the 4-entry watch cache.
+    for i in range(12):
+        store.patch_node_labels("gone-0", {"gen": str(i)})
+    with pytest.raises(ExpiredError):
+        for ev in client.watch_events(["Node"], since_rv=rv):
+            informer.handle_event(ev)
+    informer.invalidate()
+    assert not informer.fresh()
+    assert informer.stats["relists_410"] == 1
+    informer.sync()
+    assert informer.fresh()
+    assert informer.get_node("gone-0").labels["gen"] == "11"
+
+
+def test_bookmarks_and_heartbeats_refresh_freshness_without_change():
+    """BOOKMARKs and stream heartbeats mean 'the apiserver is alive and
+    nothing changed' — they must refresh the staleness clock (an idle
+    cluster keeps its cache valid) without touching the store."""
+    store = FakeCluster()
+    store.create_node(make_node("bm-0"))
+    informer = Informer(store, max_staleness_s=5.0)
+    informer.sync()
+    assert informer.fresh()
+    informer._last_heard -= 60.0
+    assert not informer.fresh()
+    informer.handle_event(None)  # idle heartbeat
+    assert informer.fresh()
+    informer._last_heard -= 60.0
+    assert not informer.fresh()
+    informer.handle_event(
+        WatchEvent(
+            type="BOOKMARK",
+            kind="Node",
+            object=None,
+            rv=store.current_resource_version(),
+        )
+    )
+    assert informer.fresh()
+    assert informer.get_node("bm-0") is not None
+    assert informer.stats["events"] == 0
+
+
+def test_write_echo_resolves_read_your_writes_with_zero_round_trips():
+    """The patch's response echo lands in the store the instant the
+    write returns, so the provider's write-then-poll visibility wait
+    resolves from the cache: zero extra get_node round trips, and no
+    waiting out the apiserver's (lagged) read cache."""
+    from k8s_operator_libs_tpu.upgrade import UpgradeKeys, UpgradeState
+    from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+        NodeUpgradeStateProvider,
+    )
+
+    lag = 0.25
+    store = FakeCluster(cache_lag_s=lag)
+    keys = UpgradeKeys()
+    node = store.create_node(make_node("rw-0"))
+    informer = Informer(store)
+    cached = CachedKubeClient(store, informer=informer)
+    informer.sync()
+    provider = NodeUpgradeStateProvider(
+        cached, keys, poll_interval_s=0.01, poll_timeout_s=5.0
+    )
+    gets_before = store.stats.get("get_node", 0)
+    t0 = time.monotonic()
+    provider.change_nodes_upgrade_state(
+        [node], UpgradeState.CORDON_REQUIRED
+    )
+    elapsed = time.monotonic() - t0
+    assert store.stats.get("get_node", 0) == gets_before, (
+        "the visibility wait read the API instead of the cache"
+    )
+    assert elapsed < lag, (
+        f"wait took {elapsed:.3f}s — it sat out the {lag}s read-cache "
+        "lag the echo exists to skip"
+    )
+    assert (
+        informer.get_node("rw-0").labels[keys.state_label]
+        == "cordon-required"
+    )
+    assert (
+        store.get_node("rw-0", cached=False).labels[keys.state_label]
+        == "cordon-required"
+    )
+
+
+def test_stale_cache_forces_quorum_reread_for_mutating_decisions():
+    """Satellite guard: a cached get_node feeding a mutating decision
+    carries a max_staleness_s bound — on breach the read falls through
+    to the API (and the fresh object re-seeds the store)."""
+    store = FakeCluster()
+    store.create_node(make_node("sg-0", labels={"v": "old"}))
+    informer = Informer(store)
+    cached = CachedKubeClient(store, informer=informer)
+    informer.sync()
+    # The world moves on while the feed is silent for 10 s.
+    store.patch_node_labels("sg-0", {"v": "new"})
+    with informer._lock:
+        informer._last_heard -= 10.0
+    # Convergence-style read (default 30 s bound): cache-served, stale.
+    assert cached.get_node("sg-0").labels["v"] == "old"
+    # Mutating-decision read with a tight bound: quorum re-read.
+    assert (
+        cached.get_node("sg-0", max_staleness_s=5.0).labels["v"] == "new"
+    )
+    # The fallthrough re-seeded the store for everyone else.
+    assert cached.get_node("sg-0").labels["v"] == "new"
+
+
+def test_fake_cluster_get_node_staleness_guard_bypasses_lagged_cache():
+    """The same guard one layer down: FakeCluster's lagged read cache is
+    bypassed when the caller's bound is tighter than the lag."""
+    store = FakeCluster(cache_lag_s=0.2)
+    store.create_node(make_node("lag-0", labels={"v": "1"}))
+    time.sleep(0.3)  # let the create become cache-visible
+    store.patch_node_labels("lag-0", {"v": "2"})
+    assert store.get_node("lag-0", cached=True).labels["v"] == "1"
+    assert (
+        store.get_node("lag-0", cached=True, max_staleness_s=0.1).labels[
+            "v"
+        ]
+        == "2"
+    )
+
+
+def test_informer_event_replay_is_idempotent_under_rv_guards():
+    """Min-floor resume replays already-applied deltas; the RV guards
+    must make replay a no-op — including a DELETED older than a live
+    recreation."""
+    store = FakeCluster()
+    store.create_node(make_node("rv-0", labels={"v": "a"}))
+    informer = Informer(store)
+    informer.sync()
+    evs = []
+    gen = store.watch_events(["Node"], since_rv=0)
+    store.patch_node_labels("rv-0", {"v": "b"})
+    for ev in gen:
+        if ev is not None:
+            evs.append(ev)
+            if len(evs) >= 2:
+                break
+    gen.close()
+    for ev in evs:  # first application
+        informer.handle_event(ev)
+    assert informer.get_node("rv-0").labels["v"] == "b"
+    for ev in reversed(evs):  # replayed, out of order
+        informer.handle_event(ev)
+    assert informer.get_node("rv-0").labels["v"] == "b"
+    # A stale DELETED (recreation already seen at a higher rv) is ignored.
+    stale_rv = evs[0].rv
+    informer.handle_event(
+        WatchEvent(
+            type="DELETED", kind="Node", object=evs[0].object, rv=stale_rv
+        )
+    )
+    assert informer.get_node("rv-0") is not None

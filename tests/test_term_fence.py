@@ -1,0 +1,136 @@
+"""The term fence: workers compare the persisted adoption stamp's term
+against their own before mutating.
+
+The liveness fence (lease renew deadline) leaves a window: a deposed
+leader's in-flight worker may act between its last successful renewal
+and the deadline, concurrently with a successor that has already
+adopted the work.  The successor's adoption pass stamps every in-flight
+node with ``<identity>@<term>``; a worker that quorum-reads a HIGHER
+term than its own knows it is deposed without waiting out any clock.
+"""
+
+from __future__ import annotations
+
+from k8s_operator_libs_tpu.api import DrainSpec
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys, UpgradeState
+from k8s_operator_libs_tpu.upgrade.durable import (
+    format_adoption_stamp,
+    make_term_fence,
+)
+from k8s_operator_libs_tpu.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainManager,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from tests.fixtures import ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+def _stamped_cluster(term: int):
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    nodes = fx.tpu_slice("pool-0", hosts=2, state=UpgradeState.DRAIN_REQUIRED)
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {
+                KEYS.adopted_by_annotation: format_adoption_stamp(
+                    "successor", term
+                )
+            },
+        )
+    return c, [c.get_node(n.name, cached=False) for n in nodes]
+
+
+def test_fence_passes_when_stamp_term_is_own_or_lower():
+    c, nodes = _stamped_cluster(term=5)
+    assert make_term_fence(c, KEYS, lambda: 5)(nodes)  # own stamp
+    assert make_term_fence(c, KEYS, lambda: 6)(nodes)  # older leader's
+
+
+def test_fence_fails_when_a_higher_term_adopted_the_nodes():
+    c, nodes = _stamped_cluster(term=7)
+    assert not make_term_fence(c, KEYS, lambda: 5)(nodes)
+
+
+def test_fence_accepts_node_names_and_single_bad_node_suffices():
+    c, nodes = _stamped_cluster(term=7)
+    # Strip the stamp from one node: the OTHER still fences the worker.
+    c.patch_node_annotations(
+        nodes[0].name, {KEYS.adopted_by_annotation: None}
+    )
+    fence = make_term_fence(c, KEYS, lambda: 5)
+    assert fence([nodes[0].name])  # bare names work; unstamped passes
+    assert not fence([n.name for n in nodes])
+
+
+def test_fence_fails_open_on_garbage_and_errors():
+    c, nodes = _stamped_cluster(term=7)
+    # Garbage stamp parses as absent.
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name, {KEYS.adopted_by_annotation: "not-a-stamp"}
+        )
+    assert make_term_fence(c, KEYS, lambda: 5)(nodes)
+    # Unreadable term source: fail open (liveness fence is the backstop).
+    assert make_term_fence(c, KEYS, lambda: 1 / 0)(nodes)
+    # Unreadable nodes: fail open too — a fence that fails closed would
+    # wedge every worker on an API blip.
+    assert make_term_fence(c, KEYS, lambda: 5)(["no-such-node"])
+
+
+def test_deposed_leader_window_worker_abandons_without_mutating():
+    """The window itself: the old leader's liveness fence still reads
+    True (its renew deadline has not passed), but the successor has
+    already stamped the group with a higher term.  The worker must
+    abandon at ENTRY — no cordon, no label transition, nothing."""
+    c, nodes = _stamped_cluster(term=9)
+    provider = NodeUpgradeStateProvider(
+        c, KEYS, poll_interval_s=0.01, poll_timeout_s=2.0
+    )
+    dm = DrainManager(c, provider, KEYS, poll_interval_s=0.01)
+    dm.fence = lambda: True  # liveness window still open
+    dm.term_fence = make_term_fence(c, KEYS, lambda: 4)  # but deposed
+    group = UpgradeGroup(
+        id="pool-0", members=[NodeUpgradeState(node=n) for n in nodes]
+    )
+    writes_before = sum(
+        c.stats.get(v, 0)
+        for v in ("patch_node", "patch_node_labels", "set_node_unschedulable")
+    )
+    dm.schedule_groups_drain(
+        DrainConfiguration(
+            spec=DrainSpec(enable=True, timeout_second=2), groups=[group]
+        )
+    )
+    assert dm.wait_idle(10.0)
+    writes_after = sum(
+        c.stats.get(v, 0)
+        for v in ("patch_node", "patch_node_labels", "set_node_unschedulable")
+    )
+    assert writes_after == writes_before, "deposed worker mutated state"
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        assert not live.spec.unschedulable
+        assert live.labels[KEYS.state_label] == UpgradeState.DRAIN_REQUIRED.value
+    # The same group under the CURRENT term drains normally.
+    dm2 = DrainManager(c, provider, KEYS, poll_interval_s=0.01)
+    dm2.fence = lambda: True
+    dm2.term_fence = make_term_fence(c, KEYS, lambda: 9)
+    dm2.schedule_groups_drain(
+        DrainConfiguration(
+            spec=DrainSpec(enable=True, timeout_second=2), groups=[group]
+        )
+    )
+    assert dm2.wait_idle(10.0)
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        assert (
+            live.labels[KEYS.state_label]
+            == UpgradeState.POD_RESTART_REQUIRED.value
+        )

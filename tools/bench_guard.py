@@ -1,0 +1,125 @@
+"""Hot-path regression guard for the informer-backed cached reconcile.
+
+``make bench-guard`` runs this standalone (no accelerator, no jax
+device work — the engine + FakeCluster only): it builds the 256-node
+steady-state pool from the scale pin (tests/test_scale.py), syncs an
+Informer, drives reconcile ticks through a CachedKubeClient, and FAILS
+if the measured ``api_requests_per_tick`` regresses above the pinned
+ceiling.  The cache serves every read in steady state, so the true
+value is 0.0; the ceiling leaves no room for a per-node GET (256/tick)
+or a per-tick LIST (>= 4/tick) to sneak back into the hot path.
+
+bench.py imports ``measure()`` for its ``cached_reconcile`` stage so
+the nightly artifact records the same numbers this gate enforces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+N_SLICES = 16
+HOSTS_PER_SLICE = 16
+TICKS = 5
+# Average API round trips per steady-state tick through the cached
+# client.  Pinned, not aspirational: the scale pin asserts exactly 0
+# reads over 3 ticks, so anything above this ceiling is a reintroduced
+# relist or per-node GET, never noise.
+API_PER_TICK_CEILING = 0.5
+
+
+def measure(
+    slices: int = N_SLICES,
+    hosts: int = HOSTS_PER_SLICE,
+    ticks: int = TICKS,
+) -> dict:
+    """One steady-state cached-reconcile measurement; returns the
+    artifact dict (also embedded in BENCH_DETAILS.json by bench.py)."""
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.k8s.informer import (
+        CachedKubeClient,
+        Informer,
+    )
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    # Already-rolled pool: every node done, every pod at the current
+    # revision — the state a controller sits in 99% of its life.
+    for i in range(slices):
+        for n in fx.tpu_slice(
+            f"pool-{i:02d}", hosts=hosts, state=UpgradeState.DONE
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("25%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+
+    informer = Informer(cluster)
+    cached = CachedKubeClient(cluster, informer=informer)
+    mgr = ClusterUpgradeStateManager(cached, keys=keys)
+    sync_before = sum(cluster.stats.values())
+    informer.sync()
+    sync_requests = sum(cluster.stats.values()) - sync_before
+
+    before = sum(cluster.stats.values())
+    for _ in range(ticks):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        if not mgr.wait_for_async_work(10.0):
+            raise RuntimeError("async upgrade work did not drain")
+    total = sum(cluster.stats.values()) - before
+
+    return {
+        "nodes": slices * hosts,
+        "ticks": ticks,
+        "sync_api_requests": sync_requests,
+        "api_requests_total": total,
+        "api_requests_per_tick": round(total / ticks, 3),
+        "cache_hits": informer.stats["cache_hits"],
+        "cache_misses": informer.stats["cache_misses"],
+        "ceiling_per_tick": API_PER_TICK_CEILING,
+    }
+
+
+def main() -> int:
+    result = measure()
+    ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
+    result["ok"] = ok
+    print(json.dumps(result, sort_keys=True))
+    if not ok:
+        print(
+            "bench-guard FAIL: steady-state cached reconcile issued "
+            f"{result['api_requests_per_tick']} API requests/tick at "
+            f"{result['nodes']} nodes (ceiling "
+            f"{API_PER_TICK_CEILING}) — a relist or per-node GET is "
+            "back in the hot path",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
