@@ -91,9 +91,12 @@ cov-report:
 bench:
 	$(PYTHON) bench.py
 
-# Hot-path regression gate: steady-state cached reconcile at 256 nodes
-# must stay under the pinned api_requests_per_tick ceiling (the
-# informer serves every read; see tools/bench_guard.py).
+# Hot-path regression gate, two stages: (1) steady-state cached
+# reconcile at 256 nodes must stay under the pinned
+# api_requests_per_tick ceiling (the informer serves every read);
+# (2) sharded dirty-set reconcile at 4096 nodes must keep tick cost
+# O(changed) — idle ticks walk 0 pools under the p99 latency ceiling,
+# one delta walks exactly 1 pool (see tools/bench_guard.py).
 bench-guard:
 	$(PYTHON) tools/bench_guard.py
 

@@ -1328,10 +1328,20 @@ def main() -> None:
     # per-node GETs).  Same measurement the bench-guard target enforces.
     sys.path.insert(0, os.path.join(_ROOT, "tools"))
     from bench_guard import measure as measure_cached_reconcile  # noqa: E402
+    from bench_guard import (  # noqa: E402
+        measure_sharded as measure_sharded_reconcile,
+    )
 
     cached_reconcile = measure_cached_reconcile()
     beat()
     log(f"cached reconcile (256-node steady state): {cached_reconcile}")
+
+    # -- sharded dirty-set reconcile (gated by `make bench-guard`) -----------
+    # The 4096-node tick-cost-is-O(changed) pin: idle ticks walk 0 pools
+    # at 0 API requests, one delta walks exactly 1 pool.
+    sharded_reconcile = measure_sharded_reconcile()
+    beat()
+    log(f"sharded reconcile (4096-node dirty set): {sharded_reconcile}")
 
     complete = seq_result["complete"]
     details = {
@@ -1381,6 +1391,7 @@ def main() -> None:
         },
         "failure_injection": failinj,
         "cached_reconcile": cached_reconcile,
+        "sharded_reconcile": sharded_reconcile,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1455,6 +1466,13 @@ def main() -> None:
         ],
         "cached_api_per_tick": cached_reconcile["api_requests_per_tick"],
         "cached_api_ceiling": cached_reconcile["ceiling_per_tick"],
+        "sharded_idle_pools_walked": sharded_reconcile[
+            "idle_pools_walked_total"
+        ],
+        "sharded_idle_p99_tick_s": sharded_reconcile["idle_p99_tick_s"],
+        "sharded_active_pools_walked": sharded_reconcile[
+            "active_pools_walked"
+        ],
         "mxu_tflops": _num(mxu.get("tflops"), 1),
         "mxu_mfu": _num(mxu.get("mfu"), 3),
         "hbm_gbps": _num(hbm.get("gbps"), 1),

@@ -96,6 +96,21 @@ class ControllerConfig:
     watch: bool = False
     # Coalesce bursts of watch events into one pass.
     watch_debounce_s: float = 0.1
+    # Sharded dirty-set reconcile (requires watch): informer deltas feed
+    # a per-pool dirty queue; event-driven passes rebuild and reconcile
+    # ONLY the touched pools on parallel worker shards, with budget
+    # arbitration through a shared maxUnavailable ledger.  interval_s
+    # becomes the full-resync safety net.  Tick cost is O(changed)
+    # instead of O(fleet) — see docs/automatic-libtpu-upgrade.md.
+    sharded: bool = False
+    # Worker shards (parallel per-pool reconciles; each pool is still
+    # serialized onto at most one shard at a time).
+    reconcile_shards: int = 4
+    # Scope the informer's Pod list+watch to the driver namespace+labels
+    # (field-selector analogue) so non-driver pod volume cannot bloat
+    # the store; out-of-scope pod queries (the drain path's per-node
+    # all-namespace listing) pass through to the live API.
+    informer_pod_scope: bool = True
     # Publish recorded transition/failure events to the cluster as
     # core/v1 Events (reference parity: every transition is an Event,
     # visible in `kubectl describe node`).
@@ -137,11 +152,46 @@ class UpgradeController:
                 Informer,
             )
 
-            self.informer = Informer(client)
+            self.informer = Informer(
+                client,
+                pod_namespace=(
+                    config.namespace if config.informer_pod_scope else ""
+                ),
+                pod_match_labels=(
+                    config.driver_labels
+                    if config.informer_pod_scope
+                    else None
+                ),
+            )
             manager_client = CachedKubeClient(client, informer=self.informer)
         self.manager = ClusterUpgradeStateManager(
             manager_client, keys=self.keys, event_recorder=self.events
         )
+        # Sharded dirty-set reconcile rides on the watch pump's event
+        # stream; without a watch there are no deltas to route.
+        self._sharded = None
+        if config.sharded and config.watch:
+            from k8s_operator_libs_tpu.upgrade.sharded import (
+                ShardedReconciler,
+            )
+
+            self._sharded = ShardedReconciler(
+                self.manager,
+                config.namespace,
+                config.driver_labels,
+                shards=config.reconcile_shards,
+                # Same liveness fence as the manager's async workers —
+                # reads self.elector at call time (set below).
+                fence=lambda: (
+                    self.elector is None or self.elector.is_leader()
+                ),
+                # Budget-release wakeups originate on shard threads, so
+                # they must set the loop's wake event themselves (watch
+                # events get theirs from the pump).
+                wake=lambda: (
+                    self._wake.set() if self._wake is not None else None
+                ),
+            )
         # TPU health gate: per-host probe-agent reports aggregated per
         # slice, pinned to the current driver revision.  The HBM floor is
         # derived per slice from the accelerator's published spec
@@ -280,7 +330,19 @@ class UpgradeController:
                     "controller_adoptions_total", float(self._adoptions)
                 )
                 self.registry.set("controller_leader_term", float(term))
+            resync_started = None
+            if self._sharded is not None:
+                # Anchor the sharded layer to ground truth: re-seed the
+                # node→pool registry and re-baseline the budget ledger
+                # from this full snapshot BEFORE acting on it.
+                resync_started = self._sharded.observe_full_state(
+                    state, self.config.policy
+                )
             self.manager.apply_state(state, self.config.policy)
+            if resync_started is not None:
+                # Deltas queued before this pass began are covered by it.
+                self._sharded.complete_full_resync(resync_started)
+                self.metrics.observe_sharded(self._sharded)
         except CircuitOpenError as e:
             self._handle_circuit_open(e)
             return False
@@ -291,6 +353,31 @@ class UpgradeController:
         self.slice_timer.observe_state(state)
         self._flush_events(state)
         return True
+
+    def reconcile_dirty(self) -> bool:
+        """One event-driven dirty pass (sharded mode): reconcile ONLY
+        the pools touched by watch deltas, on parallel worker shards —
+        an idle tick takes 0 pools and builds 0 state.  Falls back to a
+        full pass when the sharded layer is not yet seeded by a full
+        resync or a new leadership epoch still needs re-adoption."""
+        if (
+            self._sharded is None
+            or self._needs_adoption
+            or not self._sharded.ready()
+        ):
+            return self.reconcile_once()
+        try:
+            if self.config.policy_ref is not None:
+                self._refresh_policy_from_cr()
+            if not self._still_leading():
+                return False
+            report = self._sharded.tick(self.config.policy)
+        except CircuitOpenError as e:
+            self._handle_circuit_open(e)
+            return False
+        self.metrics.observe_sharded(self._sharded, report)
+        self._flush_events()
+        return report.errors == 0 and report.fenced == 0
 
     def _open_circuit_count(self) -> int:
         breaker = getattr(self.client, "breaker", None)
@@ -638,6 +725,8 @@ class UpgradeController:
 
     def stop(self, *_args) -> None:
         self._stop = True
+        if self._sharded is not None:
+            self._sharded.shutdown()
         if self._wake is not None:
             self._wake.set()  # interrupt a watch-mode resync wait
 
@@ -822,6 +911,11 @@ class UpgradeController:
                         # staleness clock (a quiet-but-connected stream
                         # keeps cached reads valid).
                         self.informer.handle_event(ev)
+                    if self._sharded is not None:
+                        # ... and the dirty-set router: the delta marks
+                        # exactly the pools it touches, which is what the
+                        # next event-driven pass reconciles.
+                        self._sharded.handle_event(ev)
                     if gate is not None and not gate.is_set():
                         # Lost leadership: drop the streams; keep the
                         # floors so regaining replays the standby gap.
@@ -885,6 +979,11 @@ class UpgradeController:
             self.config.interval_s,
             self.config.watch,
         )
+        # Sharded mode: event-driven wakes run DIRTY passes (only the
+        # touched pools); a wait that expires without a wake runs the
+        # periodic FULL resync — the safety net that catches missed
+        # deltas and re-baselines the budget ledger.
+        woken = False
         try:
             while not self._stop:
                 if self.elector is not None and not self._election_round():
@@ -898,7 +997,10 @@ class UpgradeController:
                     # mid-pass must trigger another pass, not be lost.
                     wake.clear()
                 try:
-                    self.reconcile_once()
+                    if self._sharded is not None and woken:
+                        self.reconcile_dirty()
+                    else:
+                        self.reconcile_once()
                 except Exception:  # noqa: BLE001 — loop must survive
                     logger.exception("reconcile pass failed")
                 # Event-driven: wake on the first change; otherwise the
@@ -995,6 +1097,21 @@ def main(argv: Optional[list[str]] = None) -> None:
         "periodic-resync fallback",
     )
     parser.add_argument(
+        "--sharded",
+        action="store_true",
+        help="sharded dirty-set reconcile (requires --watch): informer "
+        "deltas feed a per-pool dirty queue; event-driven passes "
+        "reconcile only the touched pools on parallel worker shards; "
+        "--interval becomes the full-resync safety net",
+    )
+    parser.add_argument(
+        "--shards",
+        type=int,
+        default=4,
+        help="worker shards for --sharded (each pool is serialized onto "
+        "at most one shard at a time)",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="run leader election over a coordination.k8s.io Lease and "
@@ -1013,6 +1130,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     args = parser.parse_args(argv)
     if args.policy_cr and args.policy_file:
         parser.error("--policy-cr and --policy-file are mutually exclusive")
+    if args.sharded and not args.watch:
+        parser.error("--sharded requires --watch (deltas feed the dirty set)")
     policy_ref = None
     if args.policy_cr:
         ns, sep, name = args.policy_cr.partition("/")
@@ -1055,6 +1174,8 @@ def main(argv: Optional[list[str]] = None) -> None:
             metrics_port=args.metrics_port,
             policy_ref=policy_ref,
             watch=args.watch,
+            sharded=args.sharded,
+            reconcile_shards=args.shards,
             leader_elect=args.leader_elect,
             lease_name=args.lease_name,
             lease_namespace=args.lease_namespace or None,

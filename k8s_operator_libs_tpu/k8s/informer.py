@@ -142,12 +142,26 @@ class Informer:
         client,
         kinds: Sequence[str] = DEFAULT_KINDS,
         max_staleness_s: float = 30.0,
+        pod_namespace: str = "",
+        pod_match_labels: Optional[dict[str, str]] = None,
     ) -> None:
         self.client = client
         self.kinds = tuple(kinds)
         # Default freshness bound for cache-served reads; per-read
         # overrides tighten it for mutating decisions.
         self.max_staleness_s = max_staleness_s
+        # Pod scope (field-selector analogue): when set, the baseline
+        # LIST is namespace/label-scoped server-side and watch deltas
+        # for out-of-scope pods are dropped at ingest, so non-driver pod
+        # volume (batch jobs, system pods on a 10k-node fleet) cannot
+        # bloat the store.  CachedKubeClient serves a pod query from
+        # this store only when the query provably falls WITHIN the
+        # scope; anything else (e.g. the drain path's all-namespace
+        # per-node listing) passes through to the live API.
+        self.pod_namespace = pod_namespace
+        self.pod_match_labels = (
+            dict(pod_match_labels) if pod_match_labels else None
+        )
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}
@@ -215,7 +229,13 @@ class Informer:
             else {}
         )
         pods = (
-            {(p.namespace, p.name): p for p in self.client.list_pods()}
+            {
+                (p.namespace, p.name): p
+                for p in self.client.list_pods(
+                    namespace=self.pod_namespace,
+                    match_labels=self.pod_match_labels,
+                )
+            }
             if "Pod" in self.kinds
             else {}
         )
@@ -255,6 +275,40 @@ class Informer:
             self._last_heard = time.monotonic()
             self.stats["lists"] += 1
         return baseline
+
+    def _pod_in_scope(self, pod: Pod) -> bool:
+        """Whether a pod belongs in this (possibly scoped) store."""
+        if self.pod_namespace and pod.namespace != self.pod_namespace:
+            return False
+        if self.pod_match_labels and not matches_labels(
+            pod.labels, self.pod_match_labels
+        ):
+            return False
+        return True
+
+    def covers_pod_query(
+        self,
+        namespace: str = "",
+        label_selector: str = "",
+        node_name: Optional[str] = None,
+        match_labels: Optional[dict[str, str]] = None,
+    ) -> bool:
+        """Whether a ``list_pods`` query provably falls within the pod
+        scope (i.e. every pod it could match is in the store).  With no
+        scope configured the store holds everything and every query is
+        covered; with a scope, the query must pin the same namespace and
+        carry a label requirement at least as tight as the scope's."""
+        if not self.pod_namespace and not self.pod_match_labels:
+            return True
+        if self.pod_namespace and namespace != self.pod_namespace:
+            return False
+        if self.pod_match_labels:
+            required = dict(_equality_pairs(label_selector))
+            required.update(match_labels or {})
+            for k, v in self.pod_match_labels.items():
+                if required.get(k) != v:
+                    return False
+        return True
 
     def _store_for(self, kind: str):
         return {
@@ -342,6 +396,13 @@ class Informer:
             if ev.type == "DELETED":
                 self._delete(ev.kind, ev.object, ev.rv)
             else:
+                if ev.kind == "Pod" and not self._pod_in_scope(ev.object):
+                    # Out-of-scope pod churn never enters the store.  A
+                    # pod relabelled OUT of scope is dropped like a
+                    # delete (it no longer belongs here).
+                    self._delete(ev.kind, ev.object, ev.rv)
+                    self.stats["pods_out_of_scope"] += 1
+                    return
                 self._put(ev.kind, deep_copy(ev.object), ev.rv)
 
     def observe_write(self, obj) -> None:
@@ -356,6 +417,8 @@ class Informer:
             ControllerRevision: "ControllerRevision",
         }.get(type(obj))
         if kind is None or kind not in self.kinds:
+            return
+        if kind == "Pod" and not self._pod_in_scope(obj):
             return
         with self._lock:
             if not self.synced:
@@ -435,12 +498,35 @@ class Informer:
                 and matches_selector(r.metadata.labels, label_selector)
             ]
 
-    def snapshot(self) -> InformerSnapshot:
-        """Deep-copied coherent view of every store, one lock hold."""
+    def snapshot(
+        self, node_names: Optional[set[str]] = None
+    ) -> InformerSnapshot:
+        """Deep-copied coherent view of every store, one lock hold.
+
+        ``node_names`` (sharded dirty-set reconcile) scopes the copy to
+        those nodes and the pods scheduled on them (via the per-node
+        index) — one pool's scoped `build_state` pays O(pool) copy cost,
+        not O(fleet).  DaemonSets and revisions are fleet-small and
+        always copied whole."""
         with self._lock:
+            if node_names is None:
+                nodes = {k: deep_copy(v) for k, v in self._nodes.items()}
+                pods = {k: deep_copy(v) for k, v in self._pods.items()}
+            else:
+                nodes = {
+                    name: deep_copy(self._nodes[name])
+                    for name in node_names
+                    if name in self._nodes
+                }
+                pods = {}
+                for name in node_names:
+                    for key in self._pods_by_node.get(name, ()):
+                        pod = self._pods.get(key)
+                        if pod is not None:
+                            pods[key] = deep_copy(pod)
             return InformerSnapshot(
-                nodes={k: deep_copy(v) for k, v in self._nodes.items()},
-                pods={k: deep_copy(v) for k, v in self._pods.items()},
+                nodes=nodes,
+                pods=pods,
                 daemon_sets={
                     k: deep_copy(v) for k, v in self._daemon_sets.items()
                 },
@@ -605,6 +691,22 @@ class CachedKubeClient:
         node_name: Optional[str] = None,
         match_labels: Optional[dict[str, str]] = None,
     ) -> list[Pod]:
+        if not self.informer.covers_pod_query(
+            namespace=namespace,
+            label_selector=label_selector,
+            node_name=node_name,
+            match_labels=match_labels,
+        ):
+            # A pod-scoped store cannot answer queries outside its scope
+            # (the drain path lists ALL pods on a node, any namespace):
+            # those go to the live API, correctness over cache hits.
+            self.informer.stats["scope_passthroughs"] += 1
+            return self._client.list_pods(
+                namespace=namespace,
+                label_selector=label_selector,
+                node_name=node_name,
+                match_labels=match_labels,
+            )
         return self._cached_list(
             "list_pods",
             namespace=namespace,
@@ -627,15 +729,18 @@ class CachedKubeClient:
             "list_controller_revisions", namespace, label_selector
         )
 
-    def coherent_snapshot(self) -> Optional[InformerSnapshot]:
+    def coherent_snapshot(
+        self, node_names: Optional[set[str]] = None
+    ) -> Optional[InformerSnapshot]:
         """One consistent view for a whole reconcile pass, or None when
         the cache cannot serve (unsynced / stale) — the caller falls
-        back to direct lists."""
+        back to direct lists.  ``node_names`` scopes the snapshot to one
+        pool's nodes (sharded reconcile)."""
         inf = self._cache()
         if inf is None:
             return None
         inf.stats["cache_hits"] += 1
-        return inf.snapshot()
+        return inf.snapshot(node_names=node_names)
 
     # -- writes: delegate, then apply the echo -------------------------------
 

@@ -140,7 +140,15 @@ class _EndpointState:
 
 
 class CircuitBreaker:
-    """Per-endpoint consecutive-transient-failure breaker."""
+    """Per-endpoint consecutive-transient-failure breaker.
+
+    Breaker state is deliberately per-process and NOT persisted across
+    leader failover: a fresh leader re-learns apiserver health within
+    ``failure_threshold`` calls (seconds), while an inherited open
+    breaker could mask an endpoint that recovered during the handoff
+    and would add a shared-write path to what is otherwise pure local
+    bookkeeping (see "Crash recovery and leader handoff semantics" in
+    docs/automatic-libtpu-upgrade.md)."""
 
     def __init__(
         self,

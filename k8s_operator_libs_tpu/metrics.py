@@ -218,6 +218,83 @@ class UpgradeMetrics:
             "informer_relists_total",
             "410-Gone invalidations that forced a full re-list",
         )
+        # Sharded dirty-set reconcile surface (absent when the
+        # controller runs the classic full-pass loop).
+        r.describe(
+            "reconcile_dirty_pools",
+            "Pools walked by the last dirty tick (0 at steady state — "
+            "the tick-cost-is-O(changed) evidence)",
+        )
+        r.describe(
+            "reconcile_shard_busy",
+            "Reconcile shards currently executing a pool pass",
+        )
+        r.describe(
+            "reconcile_shards", "Configured reconcile shard count"
+        )
+        r.describe(
+            "dirty_queue_depth", "Pools currently marked dirty (queued)"
+        )
+        r.describe(
+            "dirty_queue_in_flight",
+            "Pools claimed by a shard and not yet released",
+        )
+        r.describe(
+            "dirty_queue_oldest_wait_seconds",
+            "Age of the oldest still-queued dirty mark",
+        )
+        r.describe(
+            "dirty_tick_duration_seconds",
+            "Wall-clock of the last dirty tick (batch submit + wait)",
+        )
+        r.describe(
+            "dirty_tick_max_queue_wait_seconds",
+            "Longest time a pool in the last batch sat queued before a "
+            "shard picked it up (queue latency)",
+        )
+        r.describe(
+            "dirty_events_routed_total",
+            "Watch deltas routed into the dirty set",
+        )
+        r.describe(
+            "dirty_events_coalesced_total",
+            "Routed deltas folded into an already-dirty pool entry",
+        )
+        r.describe(
+            "dirty_pools_reconciled_total",
+            "Pool-scoped reconcile passes completed by shards",
+        )
+        r.describe(
+            "dirty_shard_errors_total",
+            "Shard passes that crashed (pool requeued)",
+        )
+        r.describe(
+            "dirty_shard_fenced_total",
+            "Shard passes abandoned by the leadership fence",
+        )
+        r.describe(
+            "dirty_pod_events_unrouted_total",
+            "Pod deltas on nodes absent from the pool registry (covered "
+            "by the node's own event or the next full resync)",
+        )
+        r.describe(
+            "full_resyncs_total",
+            "Periodic full-resync passes (safety net; re-seeds the pool "
+            "registry and re-baselines the budget ledger)",
+        )
+        r.describe(
+            "budget_unavailable_used",
+            "Unavailability units currently charged in the shared "
+            "maxUnavailable ledger (claims + external faults)",
+        )
+        r.describe(
+            "budget_unavailable_cap",
+            "Effective maxUnavailable cap the ledger enforces",
+        )
+        r.describe(
+            "budget_parallel_used",
+            "Groups currently holding an in-progress budget claim",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -308,6 +385,51 @@ class UpgradeMetrics:
             r.set(
                 "informer_snapshot_age_seconds",
                 age if age != float("inf") else -1.0,
+            )
+
+    def observe_sharded(self, sharded, report=None) -> None:
+        """Publish the sharded-reconcile surface.  Called with a
+        TickReport after each dirty tick, and without one after a full
+        resync (queue/ledger gauges still refresh there)."""
+        r = self.registry
+        r.set("reconcile_shards", sharded.shards)
+        r.set("reconcile_shard_busy", sharded.busy_shards())
+        r.set("dirty_queue_depth", sharded.queue.depth())
+        r.set("dirty_queue_in_flight", sharded.queue.in_flight())
+        r.set(
+            "dirty_queue_oldest_wait_seconds",
+            sharded.queue.oldest_wait_s(),
+        )
+        qstats = sharded.queue.stats
+        r.set(
+            "dirty_events_routed_total", qstats.get("events_routed", 0)
+        )
+        r.set(
+            "dirty_events_coalesced_total",
+            qstats.get("events_coalesced", 0),
+        )
+        r.set(
+            "dirty_pod_events_unrouted_total",
+            sharded.router.stats.get("pod_events_unrouted", 0),
+        )
+        sstats = sharded.stats
+        r.set(
+            "dirty_pools_reconciled_total",
+            sstats.get("pools_reconciled", 0),
+        )
+        r.set("dirty_shard_errors_total", sstats.get("shard_errors", 0))
+        r.set("dirty_shard_fenced_total", sstats.get("fenced", 0))
+        r.set("full_resyncs_total", sstats.get("full_resyncs", 0))
+        ledger = sharded.ledger
+        r.set("budget_unavailable_used", ledger.unavailable_used())
+        r.set("budget_unavailable_cap", ledger.max_unavailable)
+        r.set("budget_parallel_used", ledger.parallel_used())
+        if report is not None:
+            r.set("reconcile_dirty_pools", report.pools_walked)
+            r.set("dirty_tick_duration_seconds", report.duration_s)
+            r.set(
+                "dirty_tick_max_queue_wait_seconds",
+                report.max_queue_wait_s,
             )
 
 

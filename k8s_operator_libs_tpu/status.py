@@ -19,6 +19,7 @@ import json as _json
 from typing import Optional
 
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
+from k8s_operator_libs_tpu.metrics import PREFIX
 from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
 from k8s_operator_libs_tpu.upgrade.node_state_provider import node_ready
 from k8s_operator_libs_tpu.upgrade.upgrade_state import (
@@ -26,6 +27,64 @@ from k8s_operator_libs_tpu.upgrade.upgrade_state import (
     ClusterUpgradeStateManager,
 )
 from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+
+
+# Controller /metrics series → status keys for the shard-health section.
+SHARDED_METRIC_KEYS = {
+    "reconcile_shards": "shards",
+    "reconcile_shard_busy": "busyShards",
+    "reconcile_dirty_pools": "lastTickPools",
+    "dirty_queue_depth": "queueDepth",
+    "dirty_queue_in_flight": "queueInFlight",
+    "dirty_queue_oldest_wait_seconds": "queueOldestWaitSeconds",
+    "dirty_tick_duration_seconds": "lastTickSeconds",
+    "dirty_events_routed_total": "eventsRouted",
+    "dirty_events_coalesced_total": "eventsCoalesced",
+    "dirty_pools_reconciled_total": "poolsReconciled",
+    "dirty_shard_errors_total": "shardErrors",
+    "dirty_shard_fenced_total": "shardFenced",
+    "full_resyncs_total": "fullResyncs",
+    "budget_unavailable_used": "budgetUsed",
+    "budget_unavailable_cap": "budgetCap",
+    "budget_parallel_used": "budgetParallel",
+}
+
+
+def sharded_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Shard health from the controller's /metrics exposition.
+
+    The sharded reconciler lives inside the controller process; this
+    read-only tool cannot see its queue directly, so it reads the same
+    numbers the controller already exports.  Returns None when the
+    family is absent (controller running the classic full-pass loop),
+    an ``{"error": ...}`` dict when the endpoint is unreachable.
+    ``fetch`` is injectable for tests."""
+    try:
+        if fetch is None:
+            from urllib.request import urlopen
+
+            with urlopen(metrics_url, timeout=5) as resp:
+                text = resp.read().decode()
+        else:
+            text = fetch(metrics_url)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        name = name.split("{")[0]
+        if not name.startswith(PREFIX + "_"):
+            continue
+        key = SHARDED_METRIC_KEYS.get(name[len(PREFIX) + 1 :])
+        if key is None:
+            continue
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out or None
 
 
 def gather(
@@ -37,6 +96,8 @@ def gather(
     max_events: int = 10,
     lease_name: str = "tpu-upgrade-controller",
     lease_namespace: Optional[str] = None,
+    metrics_url: Optional[str] = None,
+    metrics_fetch=None,
 ) -> dict:
     """Collect the status snapshot as a JSON-shaped dict (no writes)."""
     keys = keys or UpgradeKeys()
@@ -198,6 +259,10 @@ def gather(
         pass
     except Exception:  # noqa: BLE001 — read-only nicety, never fail status
         pass
+    if metrics_url:
+        sharded = sharded_health(metrics_url, fetch=metrics_fetch)
+        if sharded is not None:
+            out["shardedReconcile"] = sharded
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -303,6 +368,30 @@ def render(status: dict) -> str:
                         f"{gid}={int(n)}" for gid, n in sorted(rb.items())
                     )
                 )
+    sharded = status.get("shardedReconcile")
+    if sharded is not None:
+        lines.append("")
+        if "error" in sharded:
+            lines.append(f"sharded reconcile: {sharded['error']}")
+        else:
+            lines.append(
+                f"sharded reconcile: {int(sharded.get('busyShards', 0))}/"
+                f"{int(sharded.get('shards', 0))} shards busy | queue "
+                f"{int(sharded.get('queueDepth', 0))} queued "
+                f"{int(sharded.get('queueInFlight', 0))} in-flight "
+                f"(oldest {sharded.get('queueOldestWaitSeconds', 0.0):.1f}s)"
+                f" | budget {int(sharded.get('budgetUsed', 0))}/"
+                f"{int(sharded.get('budgetCap', 0))}"
+            )
+            lines.append(
+                f"  lifetime: "
+                f"{int(sharded.get('poolsReconciled', 0))} pool passes, "
+                f"{int(sharded.get('fullResyncs', 0))} full resyncs, "
+                f"{int(sharded.get('eventsRouted', 0))} events routed "
+                f"({int(sharded.get('eventsCoalesced', 0))} coalesced), "
+                f"errors {int(sharded.get('shardErrors', 0))}, "
+                f"fenced {int(sharded.get('shardFenced', 0))}"
+            )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")
@@ -334,6 +423,12 @@ def main(argv: Optional[list[str]] = None) -> None:
         default="",
         help="defaults to --namespace (the controller's behavior)",
     )
+    parser.add_argument(
+        "--metrics-url",
+        default="",
+        help="controller /metrics endpoint (e.g. http://HOST:9090/metrics);"
+        " adds the sharded-reconcile shard-health section",
+    )
     parser.add_argument("--json", action="store_true", dest="as_json")
     args = parser.parse_args(argv)
     from k8s_operator_libs_tpu.controller import _parse_labels
@@ -353,6 +448,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         policy_ref=policy_ref,
         lease_name=args.lease_name,
         lease_namespace=args.lease_namespace or None,
+        metrics_url=args.metrics_url or None,
     )
     print(_json.dumps(status, indent=2) if args.as_json else render(status))
 

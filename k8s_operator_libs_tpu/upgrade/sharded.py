@@ -1,0 +1,683 @@
+"""Sharded, event-driven dirty-set reconcile.
+
+PR 4's informer zeroed steady-state API *reads*, but every tick still
+rebuilt full cluster state and walked every pool — tick cost was
+O(fleet) even when nothing changed.  This module flips the loop inside
+out (the Podracer shape from PAPERS.md: many cheap workers fed by a
+central queue, no global barrier):
+
+- :class:`DirtySetQueue` — a coalescing work queue keyed by *pool* (an
+  ICI slice, or a single non-TPU node).  Rapid deltas on one slice fold
+  into one entry; per-pool serialization guarantees a pool is never
+  reconciled by two shards at once (a key re-dirtied mid-reconcile is
+  requeued at the tail, which is also what keeps a hot pool from
+  starving cold ones — FIFO over distinct keys).
+- :class:`DeltaRouter` — maps informer watch deltas to dirty pool keys.
+  Node events resolve their slice from the event object's own labels;
+  Pod events resolve through a node→pool index; DaemonSet /
+  ControllerRevision / policy-CR events legitimately dirty the whole
+  fleet (a template or policy bump changes every pool's sync verdict).
+- :class:`BudgetLedger` — the shared ``maxUnavailable`` /
+  ``maxParallelUpgrades`` arbiter.  Scoped passes see only their own
+  pool, so the state-local slot math (which is what the unsharded path
+  uses) would let two shards each compute "1 slot free" and jointly
+  overspend; the ledger makes the claim itself atomic.  It is rebuilt
+  from the observed fleet state on every full resync, so a crash
+  between a claim and its label write self-corrects instead of leaking
+  budget forever.
+- :class:`ShardedReconciler` — a thread pool of reconcile shards.
+  ``tick()`` drains the dirty set: each pool gets a `build_state`-scoped
+  rebuild and a scoped ``apply_state`` pass on its own shard, fenced by
+  the controller's leadership fence (a deposed leader's shards abandon
+  without mutating, exactly like the PR 3 async workers).  An idle tick
+  takes zero pools, builds zero state, and costs O(µs).  The periodic
+  full resync (the controller's classic ``reconcile_once``) survives as
+  the low-frequency safety net that catches missed deltas, re-seeds the
+  pool registry, and re-baselines the ledger.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+from concurrent.futures import Future, ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.k8s.client import WatchEvent
+from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
+from k8s_operator_libs_tpu.upgrade.consts import (
+    IN_PROGRESS_STATES,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
+
+logger = get_logger(__name__)
+
+
+def pool_key_for_node(node, keys: UpgradeKeys) -> str:
+    """The dirty-set key a node reconciles under: its ICI slice id, or
+    its own name when it carries no slice identity (singleton pool).
+    Pool granularity is always slice-level — with ``slice_atomic=False``
+    a pool simply contains several singleton groups, which keeps routing
+    independent of the policy knob."""
+    info = slice_info_for_node(node, keys)
+    return info.slice_id if info is not None else node.name
+
+
+class DirtySetQueue:
+    """Thread-safe coalescing dirty set with per-pool serialization.
+
+    ``mark`` is idempotent while a key is queued (rapid events on one
+    slice coalesce); ``take`` claims keys FIFO and holds them in-flight
+    so no second shard can pick the same pool up; ``done`` releases the
+    claim and requeues at the tail if the pool was re-dirtied while its
+    reconcile ran (or if the shard asks for a retry)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> first-marked monotonic time; insertion order is FIFO.
+        self._dirty: dict[str, float] = {}
+        self._in_flight: set[str] = set()
+        self._redirty: set[str] = set()
+        self.stats: Counter = Counter()
+
+    def mark(self, key: str) -> bool:
+        """Dirty one pool.  Returns True when newly enqueued, False when
+        coalesced into an existing entry (queued or in-flight)."""
+        with self._lock:
+            self.stats["events_routed"] += 1
+            if key in self._in_flight:
+                self._redirty.add(key)
+                self.stats["events_coalesced"] += 1
+                return False
+            if key in self._dirty:
+                self.stats["events_coalesced"] += 1
+                return False
+            self._dirty[key] = time.monotonic()
+            return True
+
+    def mark_many(self, keys) -> int:
+        return sum(1 for k in keys if self.mark(k))
+
+    def take(self, max_n: Optional[int] = None) -> list[tuple[str, float]]:
+        """Claim up to ``max_n`` dirty pools (FIFO).  Returns
+        ``(key, queued_for_seconds)`` pairs; each key stays in-flight
+        until ``done``."""
+        now = time.monotonic()
+        with self._lock:
+            n = len(self._dirty) if max_n is None else max_n
+            batch: list[tuple[str, float]] = []
+            for key in list(self._dirty):
+                if len(batch) >= n:
+                    break
+                marked_at = self._dirty.pop(key)
+                self._in_flight.add(key)
+                batch.append((key, now - marked_at))
+            self.stats["pools_taken"] += len(batch)
+            return batch
+
+    def done(self, key: str, requeue: bool = False) -> None:
+        with self._lock:
+            self._in_flight.discard(key)
+            if requeue or key in self._redirty:
+                self._redirty.discard(key)
+                # Tail of the FIFO: a hot pool goes to the back, so cold
+                # pools marked meanwhile are served first (no starvation).
+                self._dirty.setdefault(key, time.monotonic())
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._dirty)
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._in_flight)
+
+    def oldest_wait_s(self) -> float:
+        with self._lock:
+            if not self._dirty:
+                return 0.0
+            return time.monotonic() - min(self._dirty.values())
+
+    def clear_marked_before(self, ts: float) -> int:
+        """Drop queued keys first marked at or before ``ts`` — a full
+        resync that STARTED at ``ts`` has already covered them.  Keys
+        marked later (mid-resync deltas that may postdate the snapshot)
+        and in-flight claims are kept."""
+        with self._lock:
+            stale = [k for k, at in self._dirty.items() if at <= ts]
+            for k in stale:
+                del self._dirty[k]
+            return len(stale)
+
+
+class DeltaRouter:
+    """WatchEvent → dirty pool keys, via a node→pool index the full
+    resync seeds and Node deltas keep current."""
+
+    def __init__(self, keys: UpgradeKeys, queue: DirtySetQueue) -> None:
+        self.keys = keys
+        self.queue = queue
+        self._lock = threading.Lock()
+        self._node_pool: dict[str, str] = {}
+        self._pool_nodes: dict[str, set[str]] = {}
+        self.stats: Counter = Counter()
+
+    # -- registry ------------------------------------------------------------
+
+    def seed(self, node_pool: dict[str, str]) -> None:
+        """Replace the node→pool index from a full-resync snapshot."""
+        with self._lock:
+            self._node_pool = dict(node_pool)
+            self._pool_nodes = {}
+            for node, pool in self._node_pool.items():
+                self._pool_nodes.setdefault(pool, set()).add(node)
+
+    def nodes_of(self, pool: str) -> set[str]:
+        with self._lock:
+            return set(self._pool_nodes.get(pool, ()))
+
+    def pools(self) -> list[str]:
+        with self._lock:
+            return list(self._pool_nodes)
+
+    def pool_of_group(self, group_id: str) -> Optional[str]:
+        """A slice group's id IS its pool key; a singleton group's id is
+        its node name, resolved through the node index."""
+        with self._lock:
+            if group_id in self._pool_nodes:
+                return group_id
+            return self._node_pool.get(group_id)
+
+    def _remember(self, node_name: str, pool: Optional[str]) -> Optional[str]:
+        """Update the index; returns the PREVIOUS pool when it changed
+        (both sides of a relabel must reconcile)."""
+        with self._lock:
+            old = self._node_pool.get(node_name)
+            if pool is None:
+                if old is not None:
+                    del self._node_pool[node_name]
+                    bucket = self._pool_nodes.get(old)
+                    if bucket is not None:
+                        bucket.discard(node_name)
+                        if not bucket:
+                            del self._pool_nodes[old]
+                return old
+            if old == pool:
+                return None
+            if old is not None:
+                bucket = self._pool_nodes.get(old)
+                if bucket is not None:
+                    bucket.discard(node_name)
+                    if not bucket:
+                        del self._pool_nodes[old]
+            self._node_pool[node_name] = pool
+            self._pool_nodes.setdefault(pool, set()).add(node_name)
+            return old
+
+    # -- routing -------------------------------------------------------------
+
+    def mark_all(self) -> int:
+        """Fleet-wide dirty: a driver template / revision / policy change
+        legitimately invalidates every pool's sync verdict."""
+        self.stats["mark_all"] += 1
+        return self.queue.mark_many(self.pools())
+
+    def route(self, ev: Optional[WatchEvent]) -> None:
+        """Feed one watch delta.  Heartbeats and bookmarks carry no
+        change; everything else dirties the pools it touches."""
+        if ev is None or ev.type == "BOOKMARK" or ev.object is None:
+            return
+        if ev.kind == "Node":
+            node = ev.object
+            if ev.type == "DELETED":
+                old = self._remember(node.metadata.name, None)
+                if old is not None:
+                    self.queue.mark(old)
+                return
+            pool = pool_key_for_node(node, self.keys)
+            old = self._remember(node.metadata.name, pool)
+            self.queue.mark(pool)
+            if old is not None:
+                self.queue.mark(old)
+            return
+        if ev.kind == "Pod":
+            node_name = getattr(ev.object.spec, "node_name", "") or ""
+            with self._lock:
+                pool = self._node_pool.get(node_name)
+            if pool is not None:
+                self.queue.mark(pool)
+            else:
+                # A pod on a node we have never seen: the node's own
+                # ADDED event (or the next full resync) routes it.
+                self.stats["pod_events_unrouted"] += 1
+            return
+        # DaemonSet, ControllerRevision, the policy CR, and any kind we
+        # do not model: conservatively dirty the fleet.
+        self.mark_all()
+
+
+class LedgerSnapshot(dict):
+    """Plain-dict view of the ledger for logging/metrics."""
+
+
+class BudgetLedger:
+    """Fleet-wide, atomic ``maxUnavailable`` / ``maxParallelUpgrades`` /
+    DCN-anti-affinity arbitration for parallel shards.
+
+    A scoped pass sees only its own pool's state, so slot math computed
+    from that state is blind to what other shards are doing in the same
+    instant.  All admission therefore goes through ``try_claim`` — one
+    lock, check-and-charge in a single step.  Claims are idempotent per
+    group (a re-reconciled pool re-claims its own charge for free) and
+    are released when the group leaves the in-progress lattice (done,
+    quarantined).  ``sync_from_state`` re-derives every charge from the
+    observed fleet during the periodic full resync, which makes the
+    ledger crash-safe and self-correcting: a leaked or stale claim
+    survives at most one resync interval."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.unit = "node"
+        self.max_parallel = 0  # 0 = unlimited
+        self.max_unavailable = 0
+        self.total_units = 0
+        self._charges: dict[str, int] = {}
+        self._dcn_of: dict[str, str] = {}
+        # Unavailability (cordoned / not-ready units) not attributable
+        # to any claimed group — external faults.  Counted against the
+        # cap, refreshed at resync.
+        self.external_unavailable = 0
+        # Groups denied a claim since the last release.  A denied pool
+        # emits no further watch events, so nothing would ever re-dirty
+        # it; releasing budget drains this set through ``on_release`` and
+        # the reconciler re-marks those pools — the roll progresses
+        # event-free instead of stalling until the next full resync.
+        self._waiters: set[str] = set()
+        self.on_release: Optional[Callable[[set[str]], None]] = None
+
+    def configure(
+        self,
+        total_units: int,
+        max_parallel: int,
+        max_unavailable: int,
+        unit: str,
+    ) -> None:
+        with self._lock:
+            self.total_units = total_units
+            self.max_parallel = max_parallel
+            self.max_unavailable = max_unavailable
+            self.unit = unit
+
+    # -- claims --------------------------------------------------------------
+
+    def _dcn_held_by_other(self, group_id: str, dcn_group: str) -> bool:
+        return any(
+            d == dcn_group and g != group_id
+            for g, d in self._dcn_of.items()
+        )
+
+    def try_claim(
+        self,
+        group_id: str,
+        cost: int,
+        dcn_group: Optional[str] = None,
+        force: bool = False,
+    ) -> bool:
+        """Atomically admit ``group_id`` at ``cost`` unavailability
+        units.  ``force`` charges past the caps (an already-cordoned
+        group is genuinely unavailable whether or not we admit it — the
+        reference's bypass, upgrade_state.go:606-616) but still records
+        the charge so other claims see it."""
+        with self._lock:
+            if group_id in self._charges:
+                # Idempotent re-claim by the group's own pool.
+                if dcn_group is not None:
+                    self._dcn_of[group_id] = dcn_group
+                return True
+            if not force:
+                denied = False
+                if dcn_group is not None and self._dcn_held_by_other(
+                    group_id, dcn_group
+                ):
+                    denied = True
+                elif (
+                    self.max_parallel > 0
+                    and len(self._charges) >= self.max_parallel
+                ):
+                    denied = True
+                else:
+                    used = (
+                        sum(self._charges.values())
+                        + self.external_unavailable
+                    )
+                    if used + cost > self.max_unavailable:
+                        denied = True
+                if denied:
+                    self._waiters.add(group_id)
+                    return False
+            self._charges[group_id] = cost
+            self._waiters.discard(group_id)
+            if dcn_group is not None:
+                self._dcn_of[group_id] = dcn_group
+            return True
+
+    def release(self, group_id: str) -> None:
+        waiters: set[str] = set()
+        with self._lock:
+            had = self._charges.pop(group_id, None)
+            self._dcn_of.pop(group_id, None)
+            self._waiters.discard(group_id)
+            if had is not None and self._waiters:
+                waiters, self._waiters = self._waiters, set()
+        # Callback OUTSIDE the lock: it marks the dirty queue (its own
+        # lock) and may wake the controller.
+        if waiters and self.on_release is not None:
+            self.on_release(waiters)
+
+    # -- introspection -------------------------------------------------------
+
+    def unavailable_used(self) -> int:
+        with self._lock:
+            return sum(self._charges.values()) + self.external_unavailable
+
+    def parallel_used(self) -> int:
+        with self._lock:
+            return len(self._charges)
+
+    def holds(self, group_id: str) -> bool:
+        with self._lock:
+            return group_id in self._charges
+
+    def snapshot(self) -> LedgerSnapshot:
+        with self._lock:
+            return LedgerSnapshot(
+                unit=self.unit,
+                total_units=self.total_units,
+                max_parallel=self.max_parallel,
+                max_unavailable=self.max_unavailable,
+                charges=dict(self._charges),
+                external_unavailable=self.external_unavailable,
+            )
+
+    def sync_from_state(self, manager, state, policy) -> None:
+        """Re-baseline every charge from the observed fleet (full-resync
+        snapshot): in-progress groups are charged at their real cost,
+        unavailable units outside any claimed group become the external
+        charge, and stale claims for vanished groups are dropped."""
+        from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+            node_ready,
+        )
+
+        unit = manager._unavailability_unit(policy)
+        total = manager._total_units(state, unit)
+        max_unavailable = total
+        if policy is not None and policy.max_unavailable is not None:
+            max_unavailable = policy.max_unavailable.scaled_value(
+                total, round_up=True
+            )
+        max_parallel = getattr(policy, "max_parallel_upgrades", 0) or 0
+        charges: dict[str, int] = {}
+        dcn_of: dict[str, str] = {}
+        claimed_nodes: set[str] = set()
+        for st in IN_PROGRESS_STATES:
+            for group in state.groups_in(st):
+                charges[group.id] = 1 if unit == "slice" else group.size()
+                if (
+                    group.slice_info is not None
+                    and group.slice_info.dcn_group is not None
+                ):
+                    dcn_of[group.id] = group.slice_info.dcn_group
+                claimed_nodes.update(m.node.name for m in group.members)
+        external = 0
+        for group in state.all_groups():
+            eff = group.effective_state(manager.keys.state_label)
+            if eff in IN_PROGRESS_STATES or eff == UpgradeState.QUARANTINED:
+                continue  # claimed above, or quarantine holds no budget
+            if unit == "slice":
+                if manager._group_unavailable(group):
+                    external += 1
+            else:
+                external += sum(
+                    1
+                    for m in group.members
+                    if m.node.spec.unschedulable or not node_ready(m.node)
+                )
+        with self._lock:
+            self.unit = unit
+            self.total_units = total
+            self.max_parallel = max_parallel
+            self.max_unavailable = max_unavailable
+            self._charges = charges
+            self._dcn_of = dcn_of
+            self.external_unavailable = external
+
+
+@dataclass
+class TickReport:
+    """What one dirty tick did — the O(changed) evidence."""
+
+    pools_walked: int = 0
+    fenced: int = 0
+    errors: int = 0
+    requeued: int = 0
+    queue_depth_after: int = 0
+    max_queue_wait_s: float = 0.0
+    duration_s: float = 0.0
+    incomplete: int = 0  # shards still running when the wait expired
+    pool_keys: list[str] = field(default_factory=list)
+
+
+class ShardedReconciler:
+    """Parallel per-pool reconcile shards over the dirty set.
+
+    One instance per controller; the watch pump feeds ``handle_event``,
+    the controller's event-driven passes call ``tick`` and its periodic
+    full passes call ``observe_full_state`` / ``complete_full_resync``
+    around the classic build/apply so the registry and ledger stay
+    anchored to ground truth."""
+
+    def __init__(
+        self,
+        manager,
+        namespace: str,
+        driver_labels: dict[str, str],
+        shards: int = 4,
+        fence: Optional[Callable[[], bool]] = None,
+        wake: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.manager = manager
+        self.namespace = namespace
+        self.driver_labels = driver_labels
+        self.shards = max(1, int(shards))
+        # Liveness fence, same contract as the PR 3 async workers: a
+        # shard checks it immediately before building/mutating and
+        # abandons (requeueing its pool) when this process no longer
+        # leads.  The manager's own term fence still guards every write
+        # inside the pass.
+        self.fence = fence
+        # Wake signal to the controller loop for marks that originate on
+        # shard threads (budget-release wakeups) rather than from watch
+        # events — the watch pump sets its own wake after routing.
+        self.wake = wake
+        self.queue = DirtySetQueue()
+        self.router = DeltaRouter(manager.keys, self.queue)
+        self.ledger = BudgetLedger()
+        self.ledger.on_release = self._on_budget_release
+        manager.budget_ledger = self.ledger
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.shards, thread_name_prefix="reconcile-shard"
+        )
+        self._busy_lock = threading.Lock()
+        self._busy = 0
+        self._outstanding: set[Future] = set()
+        self.stats: Counter = Counter()
+        self._seeded = False
+
+    # -- feed ----------------------------------------------------------------
+
+    def handle_event(self, ev: Optional[WatchEvent]) -> None:
+        self.router.route(ev)
+
+    def _on_budget_release(self, waiter_ids: set[str]) -> None:
+        """Budget freed: re-dirty the pools of groups that were denied a
+        claim.  Without this a fleet roll stalls after the first
+        ``maxUnavailable`` batch — a pool that is merely waiting its
+        turn emits no watch events, so only the (slow) full resync
+        would ever retry it."""
+        marked = 0
+        for gid in waiter_ids:
+            pool = self.router.pool_of_group(gid)
+            if pool is not None:
+                self.queue.mark(pool)
+                marked += 1
+        self.stats["budget_wakeups"] += marked
+        if marked and self.wake is not None:
+            self.wake()
+
+    def ready(self) -> bool:
+        """Dirty ticks are meaningful only once a full resync has seeded
+        the pool registry and the ledger."""
+        return self._seeded
+
+    # -- full-resync anchoring ----------------------------------------------
+
+    def observe_full_state(self, state, policy) -> float:
+        """Called with the full-resync snapshot BEFORE apply: re-seed the
+        node→pool registry and re-baseline the budget ledger from ground
+        truth.  Returns the resync start timestamp for
+        ``complete_full_resync``."""
+        started = time.monotonic()
+        node_pool: dict[str, str] = {}
+        for group in state.all_groups():
+            for member in group.members:
+                node_pool[member.node.name] = pool_key_for_node(
+                    member.node, self.manager.keys
+                )
+        self.router.seed(node_pool)
+        self.ledger.sync_from_state(self.manager, state, policy)
+        self._seeded = True
+        return started
+
+    def complete_full_resync(self, started: float) -> None:
+        """Called after the full apply: deltas queued before the resync
+        began are covered by it — drop them so the next dirty tick only
+        sees genuinely newer work."""
+        cleared = self.queue.clear_marked_before(started)
+        self.stats["full_resyncs"] += 1
+        if cleared:
+            self.stats["resync_coalesced"] += cleared
+
+    # -- dirty ticks ---------------------------------------------------------
+
+    def busy_shards(self) -> int:
+        with self._busy_lock:
+            return self._busy
+
+    def tick(
+        self,
+        policy,
+        max_pools: Optional[int] = None,
+        wait_s: float = 30.0,
+    ) -> TickReport:
+        """Drain the dirty set onto the shard pool.  Waits up to
+        ``wait_s`` for THIS batch — not a global barrier: a pool that
+        outlives the wait keeps running on its shard (still serialized,
+        still fenced) and the tick reports it as incomplete; meanwhile
+        the queue keeps accepting deltas for other pools."""
+        t0 = time.monotonic()
+        report = TickReport()
+        batch = self.queue.take(max_pools)
+        if not batch:
+            report.queue_depth_after = self.queue.depth()
+            report.duration_s = time.monotonic() - t0
+            return report
+        report.max_queue_wait_s = max(w for _, w in batch)
+        futures: dict[Future, str] = {}
+        for key, _waited in batch:
+            fut = self._pool.submit(self._reconcile_pool, key, policy)
+            futures[fut] = key
+            self._outstanding.add(fut)
+        done, pending = wait(futures, timeout=wait_s)
+        for fut in done:
+            self._outstanding.discard(fut)
+            outcome = fut.result()
+            report.pool_keys.append(futures[fut])
+            if outcome == "fenced":
+                report.fenced += 1
+            elif outcome == "error":
+                report.errors += 1
+                report.requeued += 1
+            elif outcome == "requeued":
+                report.requeued += 1
+            else:
+                report.pools_walked += 1
+        report.incomplete = len(pending)
+        report.queue_depth_after = self.queue.depth()
+        report.duration_s = time.monotonic() - t0
+        return report
+
+    def _reconcile_pool(self, key: str, policy) -> str:
+        with self._busy_lock:
+            self._busy += 1
+        try:
+            if self.fence is not None and not self.fence():
+                # Deposed leader: abandon without building or mutating;
+                # the pool stays dirty for the successor's full resync
+                # (our queue dies with the process — the SUCCESSOR's
+                # resync is what covers the work).
+                self.queue.done(key, requeue=True)
+                self.stats["fenced"] += 1
+                return "fenced"
+            scope = self.router.nodes_of(key)
+            if not scope:
+                # Pool vanished (all nodes deleted / relabelled away).
+                self.queue.done(key)
+                self.stats["empty_pools"] += 1
+                return "empty"
+            state = self.manager.build_state(
+                self.namespace,
+                self.driver_labels,
+                policy,
+                scope_nodes=scope,
+            )
+            self.manager.apply_state(state, policy, scoped=True)
+            self.queue.done(key)
+            self.stats["pools_reconciled"] += 1
+            return "ok"
+        except Exception as e:  # noqa: BLE001 — a shard crash must not
+            # lose the pool: requeue and let the next tick (or the full
+            # resync) retry.  The ledger self-corrects at resync if the
+            # crash landed between a claim and its label write.
+            logger.warning("shard reconcile of pool %s failed: %s", key, e)
+            self.queue.done(key, requeue=True)
+            self.stats["shard_errors"] += 1
+            return "error"
+        finally:
+            with self._busy_lock:
+                self._busy -= 1
+
+    # -- lifecycle / test support -------------------------------------------
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Join outstanding shard work AND the manager's async workers —
+        bench/test determinism only; the controller never blocks on
+        this."""
+        deadline = time.monotonic() + timeout_s
+        pending = list(self._outstanding)
+        if pending:
+            done, not_done = wait(
+                pending, timeout=max(0.0, deadline - time.monotonic())
+            )
+            for fut in done:
+                self._outstanding.discard(fut)
+            if not_done:
+                return False
+        remaining = max(0.1, deadline - time.monotonic())
+        return self.manager.wait_for_async_work(remaining)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -255,6 +255,11 @@ class ClusterUpgradeStateManager:
             self.stuck_detector.add_reason_source(
                 lambda _gid: breaker.describe_open() or None
             )
+        # Sharded reconcile (upgrade/sharded.py): when set, slot math is
+        # arbitrated through this fleet-wide BudgetLedger instead of the
+        # state-local arithmetic — scoped passes see one pool and would
+        # otherwise jointly overspend maxUnavailable across shards.
+        self.budget_ledger = None
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -444,13 +449,19 @@ class ClusterUpgradeStateManager:
         namespace: str,
         driver_labels: dict[str, str],
         policy: Optional[DriverUpgradePolicySpec] = None,
+        scope_nodes: Optional[set[str]] = None,
     ) -> ClusterUpgradeState:
         """Point-in-time snapshot: DaemonSets → owned pods → nodes, grouped
         by upgrade-state label and (new) by ICI slice.
 
         ``policy`` is optional (reference signature parity); pass it to
         honor ``TPUUpgradePolicySpec.slice_atomic=False`` (every node a
-        singleton group) and ``topology.hosts_per_slice`` overrides."""
+        singleton group) and ``topology.hosts_per_slice`` overrides.
+
+        ``scope_nodes`` (sharded dirty-set reconcile) restricts the
+        snapshot to the named nodes — one pool's scoped rebuild costs
+        O(pool), not O(fleet).  The DaemonSet completeness guard is
+        fleet-wide by definition and only applies to unscoped builds."""
         logger.info("building state")
         # Informer fast path: when the client exposes a fresh coherent
         # cache snapshot (CachedKubeClient), resolve daemonsets, pods,
@@ -460,7 +471,12 @@ class ClusterUpgradeStateManager:
         # cache) the direct list + per-pod provider reads keep their
         # exact semantics.
         snapshot_fn = getattr(self.client, "coherent_snapshot", None)
-        snapshot = snapshot_fn() if callable(snapshot_fn) else None
+        snapshot = None
+        if callable(snapshot_fn):
+            try:
+                snapshot = snapshot_fn(node_names=scope_nodes)
+            except TypeError:  # older/injected snapshot providers
+                snapshot = snapshot_fn()
         if snapshot is not None:
             daemon_sets = {
                 ds.metadata.uid: ds
@@ -481,6 +497,8 @@ class ClusterUpgradeStateManager:
             pods = self.client.list_pods(
                 namespace=namespace, match_labels=driver_labels
             )
+        if scope_nodes is not None:
+            pods = [p for p in pods if p.spec.node_name in scope_nodes]
 
         filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
         for ds in daemon_sets.values():
@@ -490,9 +508,15 @@ class ClusterUpgradeStateManager:
                 if not p.is_orphaned()
                 and p.metadata.owner_references[0].uid == ds.metadata.uid
             ]
-            if ds.status.desired_number_scheduled != len(ds_pods):
+            if (
+                scope_nodes is None
+                and ds.status.desired_number_scheduled != len(ds_pods)
+            ):
                 # Guard (upgrade_state.go:243-246): a partially-scheduled
-                # driver DaemonSet gives an incoherent snapshot.
+                # driver DaemonSet gives an incoherent snapshot.  A scoped
+                # build sees a pool-sized subset by construction, so the
+                # fleet-wide count cannot apply; the periodic full resync
+                # keeps enforcing it.
                 raise BuildStateError(
                     "driver DaemonSet should not have Unscheduled pods"
                 )
@@ -583,8 +607,16 @@ class ClusterUpgradeStateManager:
         self,
         current_state: Optional[ClusterUpgradeState],
         policy: Optional[DriverUpgradePolicySpec],
+        scoped: bool = False,
     ) -> None:
-        """One stateless, idempotent pass over the snapshot."""
+        """One stateless, idempotent pass over the snapshot.
+
+        ``scoped=True`` (sharded dirty-set reconcile) marks the snapshot
+        as one pool's slice of the fleet: slot admission MUST go through
+        ``self.budget_ledger`` (state-local math would overspend across
+        shards), and fleet-cadence observers (the stuck detector, whose
+        dwell tracking assumes it sees every group each pass) run only
+        on full passes."""
         if current_state is None:
             raise ValueError("currentState should not be empty")
         if policy is None or not policy.auto_upgrade:
@@ -639,25 +671,40 @@ class ClusterUpgradeStateManager:
         self.process_quarantine(current_state, policy)
 
         unit = self._unavailability_unit(policy)
-        total_units = self._total_units(current_state, unit)
-        max_unavailable = total_units
-        if policy.max_unavailable is not None:
-            max_unavailable = policy.max_unavailable.scaled_value(
-                total_units, round_up=True
+        ledger = self.budget_ledger
+        if ledger is not None:
+            # Sharded mode: the fleet-wide ledger (re-baselined every
+            # full resync) is the single arbiter; the scoped state's
+            # local totals are meaningless for admission.  Claims happen
+            # inside process_upgrade_required_groups / quarantine rejoin.
+            upgrades_available = 0
+            logger.info(
+                "budget ledger: %d/%d unavailable, %d claims (unit=%s)",
+                ledger.unavailable_used(),
+                ledger.max_unavailable,
+                ledger.parallel_used(),
+                ledger.unit,
             )
-        upgrades_available = self.get_upgrades_available_units(
-            current_state, policy.max_parallel_upgrades, max_unavailable,
-            unit, pipeline=pipeline,
-        )
-        logger.info(
-            "upgrades in progress: %d, available slots: %d (unit=%s, "
-            "maxUnavailable=%d, total=%d)",
-            self._in_progress_units(current_state, unit),
-            upgrades_available,
-            unit,
-            max_unavailable,
-            total_units,
-        )
+        else:
+            total_units = self._total_units(current_state, unit)
+            max_unavailable = total_units
+            if policy.max_unavailable is not None:
+                max_unavailable = policy.max_unavailable.scaled_value(
+                    total_units, round_up=True
+                )
+            upgrades_available = self.get_upgrades_available_units(
+                current_state, policy.max_parallel_upgrades, max_unavailable,
+                unit, pipeline=pipeline,
+            )
+            logger.info(
+                "upgrades in progress: %d, available slots: %d (unit=%s, "
+                "maxUnavailable=%d, total=%d)",
+                self._in_progress_units(current_state, unit),
+                upgrades_available,
+                unit,
+                max_unavailable,
+                total_units,
+            )
 
         self.process_done_or_unknown_groups(current_state, UpgradeState.UNKNOWN)
         self.process_done_or_unknown_groups(current_state, UpgradeState.DONE)
@@ -692,7 +739,12 @@ class ClusterUpgradeStateManager:
             self.stuck_detector.threshold_s = float(
                 policy.stuck_threshold_second
             )
-        self.stuck_detector.observe(current_state)
+        if not scoped:
+            # Dwell tracking assumes a fleet-wide snapshot (a group
+            # absent from the pass is treated as "moved on"); scoped
+            # passes see one pool, so stuck detection runs at the full
+            # -resync cadence instead.
+            self.stuck_detector.observe(current_state)
         logger.info("state manager finished processing")
 
     # -- processors ----------------------------------------------------------
@@ -815,10 +867,40 @@ class ClusterUpgradeStateManager:
                 )
                 continue
             cost = 1 if unit == "slice" else group.size()
-            if upgrades_available < cost:
+            already_cordoned = all(
+                m.node.spec.unschedulable for m in group.members
+            )
+            ledger = self.budget_ledger
+            if ledger is not None:
+                # Sharded mode: admission is an atomic fleet-wide claim
+                # — two shards each seeing "one slot free" in their own
+                # scoped state cannot jointly overspend.  The
+                # already-cordoned bypass becomes a forced claim: the
+                # group is genuinely unavailable either way, and the
+                # charge must stay visible to every other shard.
+                dcn = (
+                    group.slice_info.dcn_group
+                    if dcn_anti_affinity
+                    and group.slice_info is not None
+                    and group.slice_info.dcn_group is not None
+                    else None
+                )
+                if not ledger.try_claim(
+                    group.id, cost, dcn_group=dcn, force=already_cordoned
+                ):
+                    logger.info(
+                        "upgrade limit reached (ledger), pausing group %s",
+                        group.id,
+                    )
+                    continue
+                if already_cordoned:
+                    logger.info(
+                        "group %s already cordoned, progressing", group.id
+                    )
+            elif upgrades_available < cost:
                 # Already-cordoned groups bypass the slot limit
                 # (upgrade_state.go:606-616).
-                if all(m.node.spec.unschedulable for m in group.members):
+                if already_cordoned:
                     logger.info(
                         "group %s already cordoned, progressing", group.id
                     )
@@ -1147,6 +1229,10 @@ class ClusterUpgradeStateManager:
                 self.provider.change_nodes_upgrade_annotation(
                     annotated, keep_cordoned_key, "null"
                 )
+            if self.budget_ledger is not None:
+                # Hosts are schedulable again: free the fleet-wide
+                # unavailability charge and parallel slot.
+                self.budget_ledger.release(group.id)
 
     # -- slice quarantine (data-plane fault tolerance) -----------------------
 
@@ -1286,6 +1372,12 @@ class ClusterUpgradeStateManager:
                     self.quarantine_reasons[group.id] = (
                         f"quarantined: {reason}"
                     )
+                    if self.budget_ledger is not None:
+                        # A quarantined group holds no budget — same
+                        # contract as the state-local counters, enforced
+                        # at the ledger so other shards can spend the
+                        # freed slot immediately.
+                        self.budget_ledger.release(group.id)
                     self._move_group_bucket(
                         state, group, UpgradeState.QUARANTINED
                     )
@@ -1336,6 +1428,16 @@ class ClusterUpgradeStateManager:
                     f"quarantine cycle limit reached ({cycles}/"
                     f"{max_cycles}); demoted to upgrade-failed"
                 )
+                if self.budget_ledger is not None:
+                    # FAILED is in-progress for budget purposes (its
+                    # hosts stay cordoned): re-charge, forced — the
+                    # demotion must not be blocked by the caps.
+                    unit = self._unavailability_unit(policy)
+                    self.budget_ledger.try_claim(
+                        group.id,
+                        1 if unit == "slice" else group.size(),
+                        force=True,
+                    )
                 self._move_group_bucket(state, group, UpgradeState.FAILED)
                 continue
             reason = self._group_fault_reason(group)
@@ -1413,9 +1515,6 @@ class ClusterUpgradeStateManager:
         if policy is None or policy.max_unavailable is None:
             return True
         unit = self._unavailability_unit(policy)
-        cap = policy.max_unavailable.scaled_value(
-            self._total_units(state, unit)
-        )
         # Charge the rejoin as if fully resumed, even when no member is
         # cordoned yet (a group parked at cordon-required rejoins with
         # clean hosts but re-cordons them the same pass).
@@ -1428,6 +1527,22 @@ class ClusterUpgradeStateManager:
                 if m.node.spec.unschedulable or not node_ready(m.node)
             )
             charge = cordoned or group.size()
+        if self.budget_ledger is not None:
+            # Sharded mode: the rejoin check IS the claim — atomic, so
+            # two shards' simultaneous rejoins cannot jointly bust the
+            # cap.  A rejected claim leaves the group parked with its
+            # dwell stamp intact, exactly like the local-math path.
+            dcn = (
+                group.slice_info.dcn_group
+                if group.slice_info is not None
+                else None
+            )
+            return self.budget_ledger.try_claim(
+                group.id, charge, dcn_group=dcn
+            )
+        cap = policy.max_unavailable.scaled_value(
+            self._total_units(state, unit)
+        )
         return self._unavailable_units(state, unit) + charge <= cap
 
     # -- shared helpers ------------------------------------------------------
@@ -1488,6 +1603,11 @@ class ClusterUpgradeStateManager:
             self.provider.change_nodes_upgrade_annotation(
                 group.nodes, key, "null"
             )
+            if self.budget_ledger is not None:
+                # Straight to DONE (every host started cordoned): the
+                # uncordon processor will never see this group, so the
+                # ledger claim is released here.
+                self.budget_ledger.release(group.id)
         else:
             self.provider.change_nodes_upgrade_state(
                 group.nodes, UpgradeState.UNCORDON_REQUIRED

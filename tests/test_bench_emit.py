@@ -57,6 +57,9 @@ def _bench_shaped_summary() -> dict:
         "failinj_ctrl_recovery_ticks": 12,
         "cached_api_per_tick": 123.456,
         "cached_api_ceiling": 0.5,
+        "sharded_idle_pools_walked": 0,
+        "sharded_idle_p99_tick_s": 0.000123,
+        "sharded_active_pools_walked": 1,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,

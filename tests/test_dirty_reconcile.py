@@ -1,0 +1,693 @@
+"""Sharded, event-driven dirty-set reconcile (the tick-cost-is-
+O(changed) flip): delta→enqueue routing, coalescing, fairness,
+full-resync catch-up, the shared budget ledger, chaos (shard crash,
+deposed leader), fuzz over shard counts, and the informer's
+field-scoped Pod store."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.controller import (
+    ControllerConfig,
+    UpgradeController,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.client import WatchEvent
+from k8s_operator_libs_tpu.k8s.informer import CachedKubeClient, Informer
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.sharded import (
+    BudgetLedger,
+    DeltaRouter,
+    DirtySetQueue,
+    ShardedReconciler,
+    pool_key_for_node,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+def _policy(max_unavailable: int = 1, parallel: int = 1):
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=parallel,
+        max_unavailable=IntOrString(max_unavailable),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        health_gate=SliceHealthGateSpec(enable=False),
+    )
+
+
+# -- DirtySetQueue ------------------------------------------------------------
+
+
+class TestDirtySetQueue:
+    def test_rapid_events_coalesce_into_one_entry(self):
+        q = DirtySetQueue()
+        assert q.mark("pool-a") is True
+        for _ in range(4):
+            assert q.mark("pool-a") is False
+        assert q.depth() == 1
+        assert q.stats["events_routed"] == 5
+        assert q.stats["events_coalesced"] == 4
+
+    def test_take_serializes_per_pool(self):
+        q = DirtySetQueue()
+        q.mark("pool-a")
+        [(key, waited)] = q.take()
+        assert key == "pool-a" and waited >= 0.0
+        assert q.in_flight() == 1
+        # In-flight pool cannot be taken again by a second shard.
+        assert q.take() == []
+        # A re-dirty while running coalesces, then requeues on done.
+        assert q.mark("pool-a") is False
+        q.done("pool-a")
+        assert q.in_flight() == 0
+        assert q.depth() == 1
+
+    def test_hot_pool_requeues_at_tail(self):
+        q = DirtySetQueue()
+        q.mark("hot")
+        q.take(1)
+        q.mark("hot")  # re-dirtied mid-reconcile
+        q.mark("cold")  # a cold pool arrives meanwhile
+        q.done("hot")
+        # FIFO over distinct keys: cold is served before hot's rerun.
+        keys = [k for k, _ in q.take()]
+        assert keys == ["cold", "hot"]
+
+    def test_clear_marked_before_keeps_newer_marks(self):
+        q = DirtySetQueue()
+        q.mark("old")
+        cutoff = time.monotonic()
+        time.sleep(0.002)
+        q.mark("new")
+        assert q.clear_marked_before(cutoff) == 1
+        assert [k for k, _ in q.take()] == ["new"]
+
+
+# -- DeltaRouter --------------------------------------------------------------
+
+
+def _router_env():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    q = DirtySetQueue()
+    router = DeltaRouter(KEYS, q)
+    return cluster, fx, q, router
+
+
+class TestDeltaRouter:
+    def test_node_event_marks_its_own_pool(self):
+        _, fx, q, router = _router_env()
+        node = fx.tpu_node("pool-a", 0)
+        router.route(WatchEvent("MODIFIED", "Node", node, 1))
+        assert [k for k, _ in q.take()] == ["pool-a"]
+        assert router.pool_of_group("pool-a") == "pool-a"
+        assert router.nodes_of("pool-a") == {node.name}
+
+    def test_node_relabel_marks_both_pools(self):
+        _, fx, q, router = _router_env()
+        node = fx.tpu_node("pool-a", 0)
+        router.route(WatchEvent("ADDED", "Node", node, 1))
+        q.take()
+        for k, _ in list(q.take()):
+            q.done(k)
+        # The node moves to pool-b: both sides must reconcile.
+        node.labels["cloud.google.com/gke-nodepool"] = "pool-b"
+        router.route(WatchEvent("MODIFIED", "Node", node, 2))
+        q.done("pool-a")  # release the earlier in-flight claim
+        marked = {k for k, _ in q.take()}
+        assert marked == {"pool-a", "pool-b"}
+
+    def test_node_delete_marks_old_pool_and_forgets_node(self):
+        _, fx, q, router = _router_env()
+        node = fx.tpu_node("pool-a", 0)
+        router.route(WatchEvent("ADDED", "Node", node, 1))
+        q.take()
+        q.done("pool-a")
+        router.route(WatchEvent("DELETED", "Node", node, 2))
+        assert [k for k, _ in q.take()] == ["pool-a"]
+        assert router.nodes_of("pool-a") == set()
+
+    def test_pod_event_routes_through_node_index(self):
+        _, fx, q, router = _router_env()
+        node = fx.tpu_node("pool-a", 0)
+        router.seed({node.name: "pool-a"})
+        pod = fx.workload_pod(node)
+        router.route(WatchEvent("MODIFIED", "Pod", pod, 1))
+        assert [k for k, _ in q.take()] == ["pool-a"]
+
+    def test_pod_on_unknown_node_counts_unrouted(self):
+        _, fx, q, router = _router_env()
+        node = fx.tpu_node("pool-a", 0)
+        pod = fx.workload_pod(node)
+        router.route(WatchEvent("MODIFIED", "Pod", pod, 1))
+        assert q.depth() == 0
+        assert router.stats["pod_events_unrouted"] == 1
+
+    def test_daemonset_event_dirties_every_pool(self):
+        _, fx, q, router = _router_env()
+        n1 = fx.tpu_node("pool-a", 0)
+        n2 = fx.tpu_node("pool-b", 0)
+        router.seed({n1.name: "pool-a", n2.name: "pool-b"})
+        ds = fx.daemon_set()
+        router.route(WatchEvent("MODIFIED", "DaemonSet", ds, 1))
+        assert {k for k, _ in q.take()} == {"pool-a", "pool-b"}
+
+    def test_heartbeats_and_bookmarks_are_ignored(self):
+        _, _, q, router = _router_env()
+        router.route(None)
+        router.route(WatchEvent("BOOKMARK", "Node", None, 5))
+        assert q.depth() == 0
+
+    def test_singleton_pool_key_is_node_name(self):
+        _, fx, _, _ = _router_env()
+        plain = fx.node(name="cpu-1")
+        assert pool_key_for_node(plain, KEYS) == "cpu-1"
+
+
+# -- BudgetLedger -------------------------------------------------------------
+
+
+class TestBudgetLedger:
+    def test_cap_is_atomic_and_claims_idempotent(self):
+        led = BudgetLedger()
+        led.configure(total_units=4, max_parallel=0, max_unavailable=1,
+                      unit="slice")
+        assert led.try_claim("g1", 1)
+        assert not led.try_claim("g2", 1)  # would overspend
+        assert led.try_claim("g1", 1)  # own re-claim is free
+        assert led.unavailable_used() == 1
+        led.release("g1")
+        assert led.try_claim("g2", 1)
+
+    def test_release_wakes_denied_waiters(self):
+        led = BudgetLedger()
+        led.configure(total_units=4, max_parallel=0, max_unavailable=1,
+                      unit="slice")
+        woken: list[set] = []
+        led.on_release = woken.append
+        led.try_claim("g1", 1)
+        assert not led.try_claim("g2", 1)
+        assert not led.try_claim("g3", 1)
+        led.release("g1")
+        assert woken == [{"g2", "g3"}]
+        # Waiters drained: a second release wakes nobody.
+        led.try_claim("g2", 1)
+        led.release("g2")
+        assert woken == [{"g2", "g3"}]
+
+    def test_force_claim_bypasses_cap_but_records_charge(self):
+        led = BudgetLedger()
+        led.configure(total_units=4, max_parallel=0, max_unavailable=1,
+                      unit="slice")
+        led.try_claim("g1", 1)
+        # Already-cordoned bypass: the group is unavailable either way.
+        assert led.try_claim("g2", 1, force=True)
+        assert led.unavailable_used() == 2
+        # ... and its charge blocks further non-forced claims.
+        assert not led.try_claim("g3", 1)
+
+    def test_max_parallel_caps_claim_count(self):
+        led = BudgetLedger()
+        led.configure(total_units=8, max_parallel=2, max_unavailable=8,
+                      unit="slice")
+        assert led.try_claim("g1", 1)
+        assert led.try_claim("g2", 1)
+        assert not led.try_claim("g3", 1)
+
+    def test_dcn_anti_affinity_one_claim_per_ring(self):
+        led = BudgetLedger()
+        led.configure(total_units=8, max_parallel=0, max_unavailable=8,
+                      unit="slice")
+        assert led.try_claim("g1", 1, dcn_group="ring-0")
+        assert not led.try_claim("g2", 1, dcn_group="ring-0")
+        assert led.try_claim("g3", 1, dcn_group="ring-1")
+        led.release("g1")
+        assert led.try_claim("g2", 1, dcn_group="ring-0")
+
+    def test_sync_from_state_rebaselines_from_fleet(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set()
+        for n in fx.tpu_slice("pool-a", hosts=2,
+                              state=UpgradeState.CORDON_REQUIRED):
+            fx.driver_pod(n, ds)
+        for n in fx.tpu_slice("pool-b", hosts=2, state=UpgradeState.DONE):
+            fx.driver_pod(n, ds)
+        # pool-c: cordoned outside any in-progress group — external.
+        for n in fx.tpu_slice("pool-c", hosts=2, state=UpgradeState.DONE,
+                              unschedulable=True):
+            fx.driver_pod(n, ds)
+        mgr = ClusterUpgradeStateManager(cluster, keys=KEYS)
+        policy = _policy(max_unavailable=3)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        led = BudgetLedger()
+        led.try_claim("stale-group", 1, force=True)  # leaked claim
+        led.sync_from_state(mgr, state, policy)
+        assert led.holds("pool-a")
+        assert not led.holds("stale-group")
+        assert led.external_unavailable == 1
+        assert led.unavailable_used() == 2  # pool-a claim + pool-c fault
+
+
+# -- scoped passes + sharded reconciler ---------------------------------------
+
+
+def _sharded_env(
+    n_pools: int = 3,
+    hosts: int = 2,
+    shards: int = 2,
+    policy=None,
+    fence=None,
+    scoped_informer: bool = True,
+):
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    pools: dict[str, list] = {}
+    for i in range(n_pools):
+        name = f"pool-{chr(ord('a') + i)}"
+        pools[name] = fx.tpu_slice(name, hosts=hosts,
+                                   topology={2: "2x2x2"}.get(hosts))
+        for n in pools[name]:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    informer = Informer(
+        cluster,
+        pod_namespace=NAMESPACE if scoped_informer else "",
+        pod_match_labels=DRIVER_LABELS if scoped_informer else None,
+    )
+    cached = CachedKubeClient(cluster, informer=informer)
+    informer.sync()
+    mgr = ClusterUpgradeStateManager(
+        cached, keys=KEYS, poll_interval_s=0.01, poll_timeout_s=2.0
+    )
+    policy = policy or _policy()
+    sharded = ShardedReconciler(
+        mgr, NAMESPACE, DRIVER_LABELS, shards=shards, fence=fence
+    )
+    return cluster, fx, ds, pools, informer, mgr, policy, sharded
+
+
+def _full_resync(mgr, sharded, policy):
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+    started = sharded.observe_full_state(state, policy)
+    mgr.apply_state(state, policy)
+    sharded.complete_full_resync(started)
+
+
+class _WatchFeeder:
+    """Mini watch pump: streams FakeCluster deltas into the router the
+    way the controller's _watch_pump does."""
+
+    KINDS = ["Node", "Pod", "DaemonSet", "ControllerRevision"]
+
+    def __init__(self, cluster, sharded, informer=None):
+        self.stop = threading.Event()
+        since = int(cluster.list_page("Node", limit=1)["resourceVersion"])
+
+        def run():
+            for ev in cluster.watch_events(self.KINDS, since_rv=since):
+                if self.stop.is_set():
+                    return
+                if informer is not None:
+                    informer.handle_event(ev)
+                sharded.handle_event(ev)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.stop.set()
+
+
+class TestScopedPasses:
+    def test_scoped_build_contains_only_the_pool(self):
+        _, _, _, pools, _, mgr, policy, sharded = _sharded_env()
+        try:
+            scope = {n.name for n in pools["pool-a"]}
+            state = mgr.build_state(
+                NAMESPACE, DRIVER_LABELS, policy, scope_nodes=scope
+            )
+            names = {
+                m.node.name for g in state.all_groups() for m in g.members
+            }
+            assert names == scope
+        finally:
+            sharded.shutdown()
+
+    def test_idle_tick_walks_zero_pools(self):
+        _, _, _, _, _, mgr, policy, sharded = _sharded_env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            report = sharded.tick(policy)
+            assert report.pools_walked == 0
+            assert report.pool_keys == []
+        finally:
+            sharded.shutdown()
+
+    def test_one_delta_walks_exactly_one_pool(self):
+        cluster, _, _, pools, _, mgr, policy, sharded = _sharded_env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            node = cluster.get_node(pools["pool-b"][0].name, cached=False)
+            sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+            report = sharded.tick(policy)
+            assert report.pools_walked == 1
+            assert report.pool_keys == ["pool-b"]
+        finally:
+            sharded.shutdown()
+
+    def test_full_resync_catches_missed_delta(self):
+        _, fx, ds, pools, informer, mgr, policy, sharded = _sharded_env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            # The delta is MISSED: the template bump never reaches the
+            # router (a dropped watch stream).  Dirty ticks see nothing.
+            fx.bump_daemon_set_template(ds, "v2", revision=2)
+            informer.sync()  # cache knows; the router was never told
+            assert sharded.tick(policy).pools_walked == 0
+            # The periodic full resync is the safety net.
+            _full_resync(mgr, sharded, policy)
+            assert mgr.wait_for_async_work(10.0)
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            labeled = {
+                g.effective_state(KEYS.state_label)
+                for g in state.all_groups()
+            }
+            assert labeled != {UpgradeState.UNKNOWN}
+        finally:
+            sharded.shutdown()
+
+    def test_shard_crash_mid_reconcile_requeues_pool(self):
+        cluster, _, _, pools, _, mgr, policy, sharded = _sharded_env(
+            shards=1
+        )
+        try:
+            _full_resync(mgr, sharded, policy)
+            real_build = mgr.build_state
+            boom = {"armed": True}
+
+            def flaky(ns, labels, pol=None, scope_nodes=None):
+                if scope_nodes is not None and boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("shard crashed mid-reconcile")
+                return real_build(
+                    ns, labels, pol, scope_nodes=scope_nodes
+                )
+
+            mgr.build_state = flaky
+            node = cluster.get_node(pools["pool-a"][0].name, cached=False)
+            sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+            report = sharded.tick(policy)
+            assert report.errors == 1 and report.requeued == 1
+            assert sharded.queue.depth() == 1  # pool survived the crash
+            report = sharded.tick(policy)
+            assert report.pools_walked == 1 and report.errors == 0
+        finally:
+            sharded.shutdown()
+
+    def test_deposed_leader_shard_is_fenced_out(self):
+        leading = {"v": True}
+        cluster, _, _, pools, _, mgr, policy, sharded = _sharded_env(
+            fence=lambda: leading["v"]
+        )
+        try:
+            _full_resync(mgr, sharded, policy)
+            leading["v"] = False
+            node = cluster.get_node(pools["pool-a"][0].name, cached=False)
+            sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+            writes_before = sum(
+                v for k, v in cluster.stats.items()
+                if not k.startswith(("get_", "list_"))
+            )
+            report = sharded.tick(policy)
+            assert report.fenced == 1 and report.pools_walked == 0
+            writes_after = sum(
+                v for k, v in cluster.stats.items()
+                if not k.startswith(("get_", "list_"))
+            )
+            assert writes_after == writes_before  # no mutations
+            # The pool stays dirty for the successor's resync.
+            assert sharded.queue.depth() == 1
+        finally:
+            sharded.shutdown()
+
+
+# -- parallel-shard rolls: budget invariant + fuzz ----------------------------
+
+
+def _roll_until_done(
+    cluster, fx, ds, pools, informer, mgr, policy, sharded,
+    budget: int, ticks: int = 400,
+):
+    """Drive dirty ticks (fed by a live watch stream) until every node
+    is DONE, sampling the fleet-wide budget invariant continuously."""
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    max_seen = 0
+    violation: list[str] = []
+    stop = threading.Event()
+
+    def unavailable_slices() -> int:
+        count = 0
+        for name, nodes in pools.items():
+            live = [cluster.get_node(n.name, cached=False) for n in nodes]
+            if any(
+                n.labels.get(KEYS.state_label) == "quarantined"
+                for n in live
+            ):
+                continue
+            if any(n.spec.unschedulable for n in live):
+                count += 1
+        return count
+
+    def sampler():
+        nonlocal max_seen
+        while not stop.is_set():
+            down = unavailable_slices()
+            max_seen = max(max_seen, down)
+            if down > budget:
+                violation.append(f"{down} slices down > budget {budget}")
+                return
+            time.sleep(0.005)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    feeder = _WatchFeeder(cluster, sharded, informer=informer)
+    try:
+        # Seed AFTER the feeder attaches so no delta is lost between
+        # snapshot and stream (the controller orders it the same way).
+        _full_resync(mgr, sharded, policy)
+        done = False
+        for _ in range(ticks):
+            sharded.tick(policy, wait_s=10.0)
+            assert not violation, violation[0]
+            states = {
+                cluster.get_node(n.name, cached=False).labels.get(
+                    KEYS.state_label, ""
+                )
+                for nodes in pools.values()
+                for n in nodes
+            }
+            if states == {"upgrade-done"}:
+                done = True
+                break
+            time.sleep(0.01)
+        assert done, f"roll did not complete: {states}"
+        assert sharded.wait_idle(10.0)
+    finally:
+        feeder.close()
+        stop.set()
+        sampler_t.join(2.0)
+    assert not violation, violation[0]
+    return max_seen
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_parallel_shards_never_jointly_overspend_budget(shards):
+    cluster, fx, ds, pools, informer, mgr, policy, sharded = _sharded_env(
+        n_pools=4, shards=shards
+    )
+    try:
+        max_seen = _roll_until_done(
+            cluster, fx, ds, pools, informer, mgr, policy, sharded,
+            budget=1,
+        )
+        assert max_seen <= 1
+        # The roll made progress through the ledger, pool by pool.
+        assert sharded.stats["pools_reconciled"] >= len(pools)
+        assert sharded.ledger.parallel_used() == 0  # fully drained
+    finally:
+        sharded.shutdown()
+
+
+@pytest.mark.parametrize("shards,seed", [(1, 0), (2, 1), (3, 2), (8, 3)])
+def test_fuzz_shard_counts_hold_invariants(shards, seed):
+    """Random event storms (duplicate, stale, out-of-order-ish deltas)
+    on top of a real roll: the budget invariant and completion must hold
+    for any shard count."""
+    rng = random.Random(seed)
+    cluster, fx, ds, pools, informer, mgr, policy, sharded = _sharded_env(
+        n_pools=rng.choice([2, 3, 4]), shards=shards
+    )
+    try:
+        # Noise injector: replays random node MODIFIED events — the
+        # dirty set must coalesce them, never corrupt the roll.
+        stop = threading.Event()
+
+        def storm():
+            names = [n.name for ns in pools.values() for n in ns]
+            while not stop.is_set():
+                node = cluster.get_node(rng.choice(names), cached=False)
+                sharded.handle_event(
+                    WatchEvent("MODIFIED", "Node", node, 1)
+                )
+                time.sleep(rng.uniform(0.001, 0.01))
+
+        storm_t = threading.Thread(target=storm, daemon=True)
+        storm_t.start()
+        try:
+            max_seen = _roll_until_done(
+                cluster, fx, ds, pools, informer, mgr, policy, sharded,
+                budget=1,
+            )
+        finally:
+            stop.set()
+            storm_t.join(2.0)
+        assert max_seen <= 1
+        assert sharded.queue.stats["events_coalesced"] > 0
+    finally:
+        sharded.shutdown()
+
+
+# -- controller integration ---------------------------------------------------
+
+
+def test_sharded_controller_completes_event_driven_roll():
+    """--sharded end to end: watch pump → dirty set → shard ticks →
+    budget-release wakeups; resync interval far too long to help."""
+    store = FakeCluster()
+    fx = ClusterFixture(store, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = []
+    for name in ("pool-a", "pool-b"):
+        nodes += fx.tpu_slice(name, hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    controller = UpgradeController(
+        store,
+        ControllerConfig(
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            interval_s=120.0,
+            policy=_policy(),
+            watch=True,
+            watch_debounce_s=0.02,
+            hbm_floor_fraction=0.0,
+            sharded=True,
+            reconcile_shards=2,
+        ),
+    )
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    thread = threading.Thread(target=controller.run_forever, daemon=True)
+    thread.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            states = {
+                store.get_node(n.name, cached=False).labels.get(
+                    KEYS.state_label, ""
+                )
+                for n in nodes
+            }
+            if states == {"upgrade-done"}:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"sharded roll too slow: {states}")
+    finally:
+        controller.stop()
+        thread.join(15.0)
+    # The roll ran on dirty ticks: pools were reconciled individually
+    # and budget wakeups bridged the event-free gaps between slices.
+    assert controller._sharded.stats["pools_reconciled"] > 0
+    assert controller._sharded.stats["budget_wakeups"] >= 1
+    # The metric family is live.
+    rendered = controller.metrics.registry.render()
+    assert "tpu_operator_dirty_pools_reconciled_total" in rendered
+    assert "tpu_operator_reconcile_shards 2" in rendered
+
+
+# -- informer pod scope -------------------------------------------------------
+
+
+class TestInformerPodScope:
+    def _env(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set()
+        node = fx.tpu_node("pool-a", 0)
+        driver = fx.driver_pod(node, ds)
+        noise = [
+            fx.workload_pod(node, namespace="default") for _ in range(5)
+        ]
+        informer = Informer(
+            cluster,
+            pod_namespace=NAMESPACE,
+            pod_match_labels=DRIVER_LABELS,
+        )
+        cached = CachedKubeClient(cluster, informer=informer)
+        informer.sync()
+        return cluster, fx, node, driver, noise, informer, cached
+
+    def test_store_holds_only_driver_scoped_pods(self):
+        _, _, _, driver, _, informer, _ = self._env()
+        stored = informer.list_pods()
+        assert [p.metadata.name for p in stored] == [driver.metadata.name]
+
+    def test_covered_query_is_served_from_cache(self):
+        cluster, _, _, driver, _, informer, cached = self._env()
+        before = cluster.stats["list_pods"]
+        pods = cached.list_pods(
+            namespace=NAMESPACE, match_labels=DRIVER_LABELS
+        )
+        assert [p.metadata.name for p in pods] == [driver.metadata.name]
+        assert cluster.stats["list_pods"] == before  # no API round trip
+
+    def test_uncovered_query_passes_through_to_live_api(self):
+        cluster, _, node, driver, noise, informer, cached = self._env()
+        before = cluster.stats["list_pods"]
+        # The drain path lists ALL pods on a node across namespaces —
+        # provably outside the scoped store, must hit the API.
+        pods = cached.list_pods(node_name=node.name)
+        assert cluster.stats["list_pods"] == before + 1
+        assert len(pods) == 1 + len(noise)
+        assert informer.stats["scope_passthroughs"] >= 1
+
+    def test_out_of_scope_pod_event_is_dropped_at_ingest(self):
+        cluster, fx, node, _, _, informer, _ = self._env()
+        stray = fx.workload_pod(node, namespace="default")
+        before = len(informer.list_pods())
+        informer.handle_event(WatchEvent("ADDED", "Pod", stray, 99))
+        assert len(informer.list_pods()) == before
+        assert informer.stats["pods_out_of_scope"] >= 1

@@ -370,3 +370,58 @@ def test_steady_state_tick_at_256_nodes_issues_zero_lists():
     assert informer.stats["cache_hits"] > 0
     # And the cached snapshot agrees with the source of truth.
     assert len(state.nodes_in(UpgradeState.DONE)) == 256
+
+
+def test_idle_sharded_tick_at_256_nodes_walks_zero_pools():
+    """The sharded-reconcile pin (ISSUE 6 acceptance, scale-test tier —
+    bench-guard re-pins it at 4096): once a full resync seeds the dirty
+    set, an idle tick walks ZERO pools, builds ZERO state, and issues
+    ZERO API requests; a single node delta makes the next tick walk
+    exactly that node's pool and no other."""
+    from k8s_operator_libs_tpu.k8s.client import WatchEvent
+    from k8s_operator_libs_tpu.k8s.informer import (
+        CachedKubeClient,
+        Informer,
+    )
+    from k8s_operator_libs_tpu.upgrade.sharded import ShardedReconciler
+
+    c = FakeCluster()
+    fx = ClusterFixture(c, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    for i in range(16):
+        for n in fx.tpu_slice(
+            f"pool-{i:02d}", hosts=16, state=UpgradeState.DONE
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("25%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    informer = Informer(c, pod_namespace=NAMESPACE,
+                        pod_match_labels=DRIVER_LABELS)
+    cached = CachedKubeClient(c, informer=informer)
+    informer.sync()
+    mgr = ClusterUpgradeStateManager(cached, keys=KEYS)
+    sharded = ShardedReconciler(mgr, NAMESPACE, DRIVER_LABELS, shards=4)
+    try:
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        started = sharded.observe_full_state(state, policy)
+        mgr.apply_state(state, policy)
+        sharded.complete_full_resync(started)
+
+        before = sum(c.stats.values())
+        for _ in range(20):
+            report = sharded.tick(policy)
+            assert report.pools_walked == 0
+        assert sum(c.stats.values()) == before  # zero API cost when idle
+
+        node = c.get_node("pool-07-w3", cached=False)
+        sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+        report = sharded.tick(policy)
+        assert report.pools_walked == 1
+        assert report.pool_keys == ["pool-07"]
+        assert sharded.wait_idle(10.0)
+    finally:
+        sharded.shutdown()

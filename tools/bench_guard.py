@@ -1,16 +1,27 @@
-"""Hot-path regression guard for the informer-backed cached reconcile.
+"""Hot-path regression guard for the informer-backed cached reconcile
+and the sharded dirty-set reconcile.
 
 ``make bench-guard`` runs this standalone (no accelerator, no jax
-device work — the engine + FakeCluster only): it builds the 256-node
-steady-state pool from the scale pin (tests/test_scale.py), syncs an
-Informer, drives reconcile ticks through a CachedKubeClient, and FAILS
-if the measured ``api_requests_per_tick`` regresses above the pinned
-ceiling.  The cache serves every read in steady state, so the true
-value is 0.0; the ceiling leaves no room for a per-node GET (256/tick)
-or a per-tick LIST (>= 4/tick) to sneak back into the hot path.
+device work — the engine + FakeCluster only), in two stages:
 
-bench.py imports ``measure()`` for its ``cached_reconcile`` stage so
-the nightly artifact records the same numbers this gate enforces.
+1. **Cached reconcile** (256 nodes): builds the steady-state pool from
+   the scale pin (tests/test_scale.py), syncs an Informer, drives full
+   reconcile ticks through a CachedKubeClient, and FAILS if the
+   measured ``api_requests_per_tick`` regresses above the pinned
+   ceiling.  The cache serves every read in steady state, so the true
+   value is 0.0; the ceiling leaves no room for a per-node GET
+   (256/tick) or a per-tick LIST (>= 4/tick) to sneak back in.
+
+2. **Sharded dirty-set reconcile** (4096 nodes): seeds a
+   ShardedReconciler from one full resync, then pins
+   tick-cost-is-O(changed): idle ticks must walk exactly 0 pools and
+   issue 0 API requests, idle p99 tick latency must stay under its
+   ceiling, and a single watch delta must make the next tick walk
+   exactly 1 pool (never the fleet).
+
+bench.py imports ``measure()`` / ``measure_sharded()`` for its
+``cached_reconcile`` / ``sharded_reconcile`` stages so the nightly
+artifact records the same numbers this gate enforces.
 """
 
 from __future__ import annotations
@@ -31,6 +42,19 @@ TICKS = 5
 # reads over 3 ticks, so anything above this ceiling is a reintroduced
 # relist or per-node GET, never noise.
 API_PER_TICK_CEILING = 0.5
+
+# Sharded stage: the 4096-node pin.
+SHARDED_N_SLICES = 256
+SHARDED_HOSTS_PER_SLICE = 16
+SHARDED_IDLE_TICKS = 200
+# An idle dirty tick checks an empty queue and returns — O(µs).  The
+# ceiling is 3+ orders of magnitude above that so only a real
+# regression (an O(fleet) walk back in the idle path) can trip it,
+# never scheduler noise.
+SHARDED_IDLE_P99_CEILING_S = 0.05
+# One dirty pool = one scoped build (16 nodes) + one scoped apply; a
+# second of wall-clock means the scoped path regressed to O(fleet).
+SHARDED_ACTIVE_TICK_CEILING_S = 1.0
 
 
 def measure(
@@ -103,6 +127,109 @@ def measure(
     }
 
 
+def measure_sharded(
+    slices: int = SHARDED_N_SLICES,
+    hosts: int = SHARDED_HOSTS_PER_SLICE,
+    idle_ticks: int = SHARDED_IDLE_TICKS,
+) -> dict:
+    """Tick-cost-is-O(changed) measurement at 4096 nodes; returns the
+    artifact dict (also embedded in BENCH_DETAILS.json by bench.py)."""
+    import time
+
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.k8s.client import WatchEvent
+    from k8s_operator_libs_tpu.k8s.informer import (
+        CachedKubeClient,
+        Informer,
+    )
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+    from k8s_operator_libs_tpu.upgrade.sharded import ShardedReconciler
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    for i in range(slices):
+        for n in fx.tpu_slice(
+            f"pool-{i:03d}", hosts=hosts, state=UpgradeState.DONE
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("25%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+
+    informer = Informer(
+        cluster, pod_namespace=NAMESPACE, pod_match_labels=DRIVER_LABELS
+    )
+    cached = CachedKubeClient(cluster, informer=informer)
+    mgr = ClusterUpgradeStateManager(cached, keys=keys)
+    informer.sync()
+    sharded = ShardedReconciler(mgr, NAMESPACE, DRIVER_LABELS, shards=4)
+    try:
+        # Seed: exactly one full resync (registry + ledger), then the
+        # controller would only ever run dirty ticks until the next
+        # resync interval.
+        t0 = time.monotonic()
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        started = sharded.observe_full_state(state, policy)
+        mgr.apply_state(state, policy)
+        sharded.complete_full_resync(started)
+        seed_resync_s = time.monotonic() - t0
+
+        api_before = sum(cluster.stats.values())
+        idle_walked = 0
+        idle_durations: list[float] = []
+        for _ in range(idle_ticks):
+            report = sharded.tick(policy)
+            idle_walked += report.pools_walked
+            idle_durations.append(report.duration_s)
+        idle_api = sum(cluster.stats.values()) - api_before
+        idle_durations.sort()
+        p50 = idle_durations[len(idle_durations) // 2]
+        p99 = idle_durations[int(len(idle_durations) * 0.99)]
+
+        # One watch delta on one node: the next tick must walk exactly
+        # that node's pool and nothing else.
+        node = cluster.get_node("pool-000-w0", cached=False)
+        sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+        t0 = time.monotonic()
+        report = sharded.tick(policy)
+        active_tick_s = time.monotonic() - t0
+        if not sharded.wait_idle(30.0):
+            raise RuntimeError("sharded reconcile did not drain")
+    finally:
+        sharded.shutdown()
+
+    return {
+        "nodes": slices * hosts,
+        "pools": slices,
+        "seed_resync_s": round(seed_resync_s, 3),
+        "idle_ticks": idle_ticks,
+        "idle_pools_walked_total": idle_walked,
+        "idle_api_requests_total": idle_api,
+        "idle_p50_tick_s": round(p50, 6),
+        "idle_p99_tick_s": round(p99, 6),
+        "active_pools_walked": report.pools_walked,
+        "active_tick_s": round(active_tick_s, 4),
+        "idle_p99_ceiling_s": SHARDED_IDLE_P99_CEILING_S,
+        "active_tick_ceiling_s": SHARDED_ACTIVE_TICK_CEILING_S,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -117,6 +244,45 @@ def main() -> int:
             "back in the hot path",
             file=sys.stderr,
         )
+        return 1
+
+    sharded = measure_sharded()
+    failures = []
+    if sharded["idle_pools_walked_total"] != 0:
+        failures.append(
+            f"idle ticks walked {sharded['idle_pools_walked_total']} "
+            "pools (must be 0 — tick cost is no longer O(changed))"
+        )
+    if sharded["idle_api_requests_total"] != 0:
+        failures.append(
+            f"idle ticks issued {sharded['idle_api_requests_total']} "
+            "API requests (must be 0)"
+        )
+    if sharded["idle_p99_tick_s"] > SHARDED_IDLE_P99_CEILING_S:
+        failures.append(
+            f"idle p99 tick latency {sharded['idle_p99_tick_s']}s > "
+            f"ceiling {SHARDED_IDLE_P99_CEILING_S}s"
+        )
+    if sharded["active_pools_walked"] != 1:
+        failures.append(
+            f"one delta walked {sharded['active_pools_walked']} pools "
+            "(must be exactly 1)"
+        )
+    if sharded["active_tick_s"] > SHARDED_ACTIVE_TICK_CEILING_S:
+        failures.append(
+            f"active tick took {sharded['active_tick_s']}s > ceiling "
+            f"{SHARDED_ACTIVE_TICK_CEILING_S}s (scoped build regressed "
+            "to O(fleet)?)"
+        )
+    sharded["ok"] = not failures
+    print(json.dumps(sharded, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(
+                f"bench-guard FAIL (sharded, {sharded['nodes']} nodes): "
+                f"{f}",
+                file=sys.stderr,
+            )
         return 1
     return 0
 
