@@ -299,6 +299,11 @@ class UpgradeController:
                     self._current_driver_revision()
                 )
                 self.agent_reconciler.reconcile()
+            # Stamp BEFORE the build: deltas that land while the (slow,
+            # fleet-sized) snapshot is being assembled are not in it, so
+            # the sharded layer must not treat them as covered by this
+            # resync.  Only marks older than this instant may be cleared.
+            resync_t0 = time.monotonic()
             try:
                 state = self.manager.build_state(
                     self.config.namespace,
@@ -336,7 +341,7 @@ class UpgradeController:
                 # node→pool registry and re-baseline the budget ledger
                 # from this full snapshot BEFORE acting on it.
                 resync_started = self._sharded.observe_full_state(
-                    state, self.config.policy
+                    state, self.config.policy, started=resync_t0
                 )
             self.manager.apply_state(state, self.config.policy)
             if resync_started is not None:
@@ -980,10 +985,16 @@ class UpgradeController:
             self.config.watch,
         )
         # Sharded mode: event-driven wakes run DIRTY passes (only the
-        # touched pools); a wait that expires without a wake runs the
-        # periodic FULL resync — the safety net that catches missed
-        # deltas and re-baselines the budget ledger.
+        # touched pools); the periodic FULL resync — the safety net that
+        # catches missed deltas, re-baselines the budget ledger, and
+        # runs stuck detection — is paced by wall clock, NOT by the wait
+        # expiring quietly.  The wait below restarts after every pass,
+        # so under sustained watch traffic (routine on a 10k-node fleet)
+        # it would never expire and the full pass would starve; instead
+        # a full pass is forced whenever one hasn't SUCCEEDED within
+        # interval_s, regardless of wake activity.
         woken = False
+        last_full = float("-inf")
         try:
             while not self._stop:
                 if self.elector is not None and not self._election_round():
@@ -997,10 +1008,14 @@ class UpgradeController:
                     # mid-pass must trigger another pass, not be lost.
                     wake.clear()
                 try:
-                    if self._sharded is not None and woken:
+                    full_due = (
+                        time.monotonic() - last_full
+                        >= self.config.interval_s
+                    )
+                    if self._sharded is not None and woken and not full_due:
                         self.reconcile_dirty()
-                    else:
-                        self.reconcile_once()
+                    elif self.reconcile_once():
+                        last_full = time.monotonic()
                 except Exception:  # noqa: BLE001 — loop must survive
                     logger.exception("reconcile pass failed")
                 # Event-driven: wake on the first change; otherwise the

@@ -420,18 +420,21 @@ class BudgetLedger:
                 total, round_up=True
             )
         max_parallel = getattr(policy, "max_parallel_upgrades", 0) or 0
+        # DCN arbitration only exists when the policy asks for it —
+        # recording dcn_of with the knob off would make try_claim deny
+        # same-DCN groups the admission path deliberately allows.
+        dcn_anti_affinity = bool(getattr(policy, "dcn_anti_affinity", False))
         charges: dict[str, int] = {}
         dcn_of: dict[str, str] = {}
-        claimed_nodes: set[str] = set()
         for st in IN_PROGRESS_STATES:
             for group in state.groups_in(st):
                 charges[group.id] = 1 if unit == "slice" else group.size()
                 if (
-                    group.slice_info is not None
+                    dcn_anti_affinity
+                    and group.slice_info is not None
                     and group.slice_info.dcn_group is not None
                 ):
                     dcn_of[group.id] = group.slice_info.dcn_group
-                claimed_nodes.update(m.node.name for m in group.members)
         external = 0
         for group in state.all_groups():
             eff = group.effective_state(manager.keys.state_label)
@@ -545,12 +548,20 @@ class ShardedReconciler:
 
     # -- full-resync anchoring ----------------------------------------------
 
-    def observe_full_state(self, state, policy) -> float:
+    def observe_full_state(
+        self, state, policy, started: Optional[float] = None
+    ) -> float:
         """Called with the full-resync snapshot BEFORE apply: re-seed the
         node→pool registry and re-baseline the budget ledger from ground
         truth.  Returns the resync start timestamp for
-        ``complete_full_resync``."""
-        started = time.monotonic()
+        ``complete_full_resync``.
+
+        ``started`` must be stamped BEFORE the snapshot build began:
+        only deltas marked earlier than that are provably covered by the
+        snapshot.  Defaulting to now is safe only when no deltas can
+        have arrived during the build (synchronous tests/benches)."""
+        if started is None:
+            started = time.monotonic()
         node_pool: dict[str, str] = {}
         for group in state.all_groups():
             for member in group.members:

@@ -1532,9 +1532,13 @@ class ClusterUpgradeStateManager:
             # two shards' simultaneous rejoins cannot jointly bust the
             # cap.  A rejected claim leaves the group parked with its
             # dwell stamp intact, exactly like the local-math path.
+            # DCN gating mirrors the admission path: with the knob off,
+            # a same-DCN slice in flight must not block the rejoin.
             dcn = (
                 group.slice_info.dcn_group
-                if group.slice_info is not None
+                if isinstance(policy, TPUUpgradePolicySpec)
+                and policy.dcn_anti_affinity
+                and group.slice_info is not None
                 else None
             )
             return self.budget_ledger.try_claim(

@@ -266,6 +266,50 @@ class TestBudgetLedger:
         assert led.unavailable_used() == 2  # pool-a claim + pool-c fault
 
 
+class TestLedgerDcnGating:
+    """DCN arbitration must exist in the ledger ONLY when the policy
+    asks for it — recording rings with the knob off would deny same-DCN
+    rejoins the admission path deliberately allows."""
+
+    def _env(self, dcn_anti_affinity: bool):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set()
+        # pool-a0 is mid-roll; pool-a1 (same ring) is parked and wants
+        # to rejoin.
+        for n in fx.tpu_slice("pool-a0", hosts=2, dcn_group="ring-a",
+                              state=UpgradeState.DRAIN_REQUIRED):
+            fx.driver_pod(n, ds)
+        for n in fx.tpu_slice("pool-a1", hosts=2, dcn_group="ring-a",
+                              state=UpgradeState.QUARANTINED):
+            fx.driver_pod(n, ds)
+        mgr = ClusterUpgradeStateManager(cluster, keys=KEYS)
+        policy = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=2,
+            max_unavailable=IntOrString("100%"),
+            dcn_anti_affinity=dcn_anti_affinity,
+        )
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        led = BudgetLedger()
+        led.sync_from_state(mgr, state, policy)
+        mgr.budget_ledger = led
+        group = next(g for g in state.all_groups() if g.id == "pool-a1")
+        return mgr, state, policy, led, group
+
+    def test_knob_off_rejoin_ignores_busy_ring(self):
+        mgr, state, policy, led, group = self._env(dcn_anti_affinity=False)
+        # The resync recorded no rings ...
+        assert led._dcn_of == {}
+        # ... so the rejoin claim is not blocked by pool-a0's flight.
+        assert mgr._rejoin_budget_free(state, policy, group) is True
+
+    def test_knob_on_rejoin_defers_to_busy_ring(self):
+        mgr, state, policy, led, group = self._env(dcn_anti_affinity=True)
+        assert led._dcn_of == {"pool-a0": "ring-a"}
+        assert mgr._rejoin_budget_free(state, policy, group) is False
+
+
 # -- scoped passes + sharded reconciler ---------------------------------------
 
 
@@ -305,8 +349,9 @@ def _sharded_env(
 
 
 def _full_resync(mgr, sharded, policy):
+    t0 = time.monotonic()  # pre-build stamp, as the controller does
     state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
-    started = sharded.observe_full_state(state, policy)
+    started = sharded.observe_full_state(state, policy, started=t0)
     mgr.apply_state(state, policy)
     sharded.complete_full_resync(started)
 
@@ -391,6 +436,30 @@ class TestScopedPasses:
                 for g in state.all_groups()
             }
             assert labeled != {UpgradeState.UNKNOWN}
+        finally:
+            sharded.shutdown()
+
+    def test_delta_during_snapshot_build_survives_resync_clear(self):
+        """A delta that lands WHILE the full-resync snapshot is being
+        built is not in that snapshot — completing the resync must not
+        clear it (the stamp is taken before the build, as the controller
+        does, so only provably-covered marks are dropped)."""
+        cluster, _, _, pools, _, mgr, policy, sharded = _sharded_env()
+        try:
+            _full_resync(mgr, sharded, policy)  # seed
+            t0 = time.monotonic()
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            # Mid-build delta: arrives after the stamp, missing from the
+            # snapshot just built.
+            node = cluster.get_node(pools["pool-b"][0].name, cached=False)
+            sharded.handle_event(WatchEvent("MODIFIED", "Node", node, 1))
+            started = sharded.observe_full_state(state, policy, started=t0)
+            mgr.apply_state(state, policy)
+            sharded.complete_full_resync(started)
+            # Not covered by the resync → still dirty, reconciled next.
+            report = sharded.tick(policy)
+            assert report.pools_walked == 1
+            assert report.pool_keys == ["pool-b"]
         finally:
             sharded.shutdown()
 
@@ -636,6 +705,68 @@ def test_sharded_controller_completes_event_driven_roll():
     rendered = controller.metrics.registry.render()
     assert "tpu_operator_dirty_pools_reconciled_total" in rendered
     assert "tpu_operator_reconcile_shards 2" in rendered
+
+
+def test_sustained_watch_traffic_does_not_starve_full_resync():
+    """The interval wait restarts after every pass, so a watch-event
+    storm (routine on a big fleet: node heartbeats alone) used to keep
+    it from ever expiring — dirty passes forever, the full-resync
+    safety net (ledger re-baseline, registry re-seed, stuck detection)
+    never ran.  Full passes must be paced by wall clock instead."""
+    store = FakeCluster()
+    fx = ClusterFixture(store, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+
+    controller = UpgradeController(
+        store,
+        ControllerConfig(
+            namespace=NAMESPACE,
+            driver_labels=DRIVER_LABELS,
+            interval_s=0.3,
+            policy=_policy(),
+            watch=True,
+            watch_debounce_s=0.0,
+            hbm_floor_fraction=0.0,
+            sharded=True,
+            reconcile_shards=2,
+        ),
+    )
+    controller.manager.provider.poll_interval_s = 0.01
+    controller.manager.provider.poll_timeout_s = 2.0
+    thread = threading.Thread(target=controller.run_forever, daemon=True)
+    thread.start()
+    stop = threading.Event()
+
+    def storm():  # node-status churn: a wake fires on every pass's wait
+        i = 0
+        while not stop.is_set():
+            store.patch_node_annotations(
+                nodes[0].name, {"test/heartbeat": str(i)}
+            )
+            i += 1
+            time.sleep(0.01)
+
+    storm_t = threading.Thread(target=storm, daemon=True)
+    storm_t.start()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if controller._sharded.stats["full_resyncs"] >= 3:
+                break
+            time.sleep(0.05)
+        # ≥3 means periodic full passes KEPT running under the storm,
+        # not just the initial seed resync.
+        assert controller._sharded.stats["full_resyncs"] >= 3
+        # The storm really was delivering events the whole time.
+        assert controller._sharded.queue.stats["events_routed"] > 0
+    finally:
+        stop.set()
+        storm_t.join(2.0)
+        controller.stop()
+        thread.join(15.0)
 
 
 # -- informer pod scope -------------------------------------------------------

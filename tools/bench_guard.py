@@ -185,7 +185,7 @@ def measure_sharded(
         # resync interval.
         t0 = time.monotonic()
         state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
-        started = sharded.observe_full_state(state, policy)
+        started = sharded.observe_full_state(state, policy, started=t0)
         mgr.apply_state(state, policy)
         sharded.complete_full_resync(started)
         seed_resync_s = time.monotonic() - t0
