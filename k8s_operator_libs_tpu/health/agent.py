@@ -115,6 +115,7 @@ class HealthAgent:
         dcn_peers: Optional[Sequence[str]] = None,
         dcn_group: str = "",
         dcn_expected_groups: Optional[Sequence[str]] = None,
+        fused: Optional[bool] = None,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -145,6 +146,12 @@ class HealthAgent:
         self.dcn_expected_groups = (
             list(dcn_expected_groups) if dcn_expected_groups else None
         )
+        # Fused single-dispatch battery (health.fused); None resolves
+        # the K8S_TPU_FUSED_BATTERY env default (on).  The fused program
+        # is fully static, so multi-host slice_wide agents enqueue
+        # identical SPMD programs — and every agent of a slice shares
+        # the topology-keyed compile across probe cycles.
+        self.fused = fused
 
     def probe_once(self) -> HealthReport:
         kwargs = {} if self.max_iters is None else {"max_iters": self.max_iters}
@@ -157,6 +164,7 @@ class HealthAgent:
             dcn_peers=self.dcn_peers,
             dcn_group=self.dcn_group,
             dcn_expected_groups=self.dcn_expected_groups,
+            fused=self.fused,
             **kwargs,
         )
         # Derive the visible-device count from the enumeration check

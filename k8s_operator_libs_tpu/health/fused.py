@@ -1,0 +1,529 @@
+"""Fused XLA probe battery with a topology-keyed compile cache.
+
+The unfused battery (:mod:`k8s_operator_libs_tpu.health.probes`) runs the
+device-health checks as separate jit programs — device inventory, MXU
+matmul, HBM stream, ICI all-reduce, ICI ring — each paying its own
+compile, dispatch, and readback.  Warm, the battery costs 6-9 s per node,
+and during a roll that cost is the wall-clock hog (every validated group
+waits on it serially).
+
+This module fuses the matmul + HBM + ICI checks into ONE compiled XLA
+program: a single ``shard_map`` over the slice mesh whose body runs every
+correctness chain and both collectives, and whose outputs are small
+per-device verification scalars.  One dispatch, one readback, and —
+because the program is fully static — one compile per *topology*:
+
+- **one dispatch** — all hosts of a group launch the same SPMD program at
+  once (slice-parallel), so the per-node cost is a single XLA execution
+  instead of five serialized probe programs;
+- **topology-keyed compile cache** — the compiled executable is cached
+  keyed by (battery version, chip generation, device count, process
+  layout, problem sizes), so node N+1 of the same topology pays zero
+  compile time;
+- **identical verdicts** — the single output decomposes back into the
+  existing per-check :class:`~.probes.CheckResult` set (same names, same
+  pass/fail semantics, same threshold behavior).  The fused program
+  cannot run the sustained-slope estimator (that requires many timed
+  dispatches — the very thing fusion removes), so fused checks carry no
+  throughput figures; downstream floor logic already treats a missing
+  figure as neither-pass-nor-fail (the ``timing_inconclusive``
+  convention), which keeps threshold application identical.
+
+Each fused check's ``metrics`` carry the battery telemetry —
+``fused``, ``battery_cache_hit``, ``battery_compile_ms``,
+``battery_execute_ms`` — so the cold-vs-warm split is visible per
+:class:`CheckResult` (and, through the agent's report annotation, per
+node in the status CLI).
+
+All verification math reuses the analytic invariants of the unfused
+probes (see probes.py): chained ``C ← C @ B`` stays exactly 0.5, chained
+``x ← x + 1`` from zeros equals the iteration count, ``psum`` of ramp
+constants equals n(n+1)/2, and a +1 ring ``ppermute`` leaves shard i
+holding i-1 (mod n) — so any deviation is a compute/link fault, not
+rounding.  The program is fully static (no timing-derived control flow),
+so it is SPMD-safe under multi-process ``jax.distributed`` probing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.health.probes import (
+    CheckResult,
+    ICI_AXIS,
+    shard_map,
+)
+
+logger = get_logger(__name__)
+
+# Bump when the fused program's math or output layout changes: a cached
+# executable from an older battery must never serve a newer decomposition.
+BATTERY_VERSION = 1
+
+# Static chain lengths.  The fused battery verifies CORRECTNESS (exact
+# analytic invariants over a dependent chain); a handful of iterations is
+# enough to exercise the MXU/HBM paths end-to-end without making the
+# single dispatch itself slow.  Static — never timing-derived — so every
+# process of a multi-host slice compiles and enqueues the identical
+# program.
+MATMUL_CHAIN_ITERS = 8
+HBM_CHAIN_ITERS = 8
+PSUM_ROUNDS = 4
+
+
+@dataclass(frozen=True)
+class BatteryKey:
+    """Compile-cache key: everything that shapes the fused XLA program.
+
+    Two nodes with the same chip generation, device count, process
+    layout, and probe sizes run byte-identical programs — the second one
+    must pay zero compile time."""
+
+    version: int
+    device_kind: str
+    device_count: int
+    # Per-process device counts, sorted — the mesh/process layout (a
+    # 4-host x 4-chip slice compiles a different SPMD program than a
+    # single 16-chip host).
+    process_layout: tuple[int, ...]
+    matmul_n: int
+    hbm_mib: int
+    allreduce_elems: int
+    skip_ici: bool
+
+
+def battery_key(
+    devices: Sequence[jax.Device],
+    matmul_n: int,
+    hbm_mib: int,
+    allreduce_elems: int,
+    skip_ici: bool,
+) -> BatteryKey:
+    per_process: dict[int, int] = {}
+    for d in devices:
+        per_process[d.process_index] = per_process.get(d.process_index, 0) + 1
+    kinds = sorted({d.device_kind for d in devices})
+    return BatteryKey(
+        version=BATTERY_VERSION,
+        device_kind=",".join(kinds),
+        device_count=len(devices),
+        process_layout=tuple(sorted(per_process.values())),
+        matmul_n=matmul_n,
+        hbm_mib=hbm_mib,
+        allreduce_elems=allreduce_elems,
+        skip_ici=skip_ici,
+    )
+
+
+@dataclass
+class _CompiledBattery:
+    """One cached, ready-to-launch fused battery."""
+
+    key: BatteryKey
+    mesh: Mesh
+    fn: object  # AOT-compiled executable or jitted fallback
+    aot: bool
+    compile_ms: float
+    input_shardings: tuple
+
+
+_LOCK = threading.Lock()
+_CACHE: dict[BatteryKey, _CompiledBattery] = {}
+_STATS = {
+    "compile_cache_hits": 0,
+    "compile_cache_misses": 0,
+    "fallbacks": 0,
+    "last_compile_ms": 0.0,
+    "last_execute_ms": 0.0,
+}
+
+
+def battery_stats() -> dict:
+    """Snapshot of cache/timing counters (metrics + bench consumers)."""
+    with _LOCK:
+        stats = dict(_STATS)
+        stats["cached_programs"] = float(len(_CACHE))
+        return stats
+
+
+def record_fallback() -> None:
+    """Count one fused→unfused fallback (called by run_host_probe)."""
+    with _LOCK:
+        _STATS["fallbacks"] += 1
+
+
+def reset_battery_cache() -> None:
+    """Drop every cached executable and zero the counters (tests)."""
+    with _LOCK:
+        _CACHE.clear()
+        for k in _STATS:
+            _STATS[k] = 0.0 if k.startswith("last_") else 0
+
+
+def _build_battery_fn(key: BatteryKey, mesh: Mesh):
+    """Trace the fused program for ``key`` over ``mesh``.
+
+    Inputs (a, b, x, ramp, ring) and outputs are described below; the
+    body chains every probe computation so nothing can be elided, then
+    reduces each check to small per-device verification scalars."""
+    n = key.matmul_n
+    n_dev = key.device_count
+    probe_ici = not key.skip_ici and n_dev >= 2
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def body(a, b, x, ramp, ring):
+        # MXU: chained C ← C @ B keeps every element exactly 0.5
+        # (power-of-two n, f32 accumulation) — per-device max abs error
+        # against the invariant is the verification scalar.
+        def mm_step(_, c):
+            return jnp.matmul(
+                c, b, preferred_element_type=jnp.float32
+            ).astype(a.dtype)
+
+        c = jax.lax.fori_loop(0, MATMUL_CHAIN_ITERS, mm_step, a)
+        mm_err = jnp.max(
+            jnp.abs(c.astype(jnp.float32) - jnp.float32(0.5))
+        ).reshape(1)
+
+        # HBM: chained x ← x + 1 from zeros; after the loop every
+        # element must equal the iteration count exactly.
+        def hbm_step(_, v):
+            return v + 1.0
+
+        x = jax.lax.fori_loop(0, HBM_CHAIN_ITERS, hbm_step, x)
+        hbm_min = jnp.min(x).reshape(1)
+        hbm_max = jnp.max(x).reshape(1)
+
+        if probe_ici:
+            # ICI all-reduce: chained psum rounds, each dependent on the
+            # last so none can be elided.  s ← psum(s)/n maps the ramp
+            # (device i holds i+1) to n(n+1)/2 / n = (n+1)/2 after round
+            # one and is a fixed point thereafter — every value along
+            # the chain is exactly representable in f32, so the final
+            # shard value must equal (n+1)/2 exactly on every device.
+            s = ramp
+            for _ in range(PSUM_ROUNDS):
+                s = jax.lax.psum(s, ICI_AXIS) / jnp.float32(n_dev)
+            psum_out = s[:, :1]
+            # ICI ring: ppermute by +1; shard i receives shard i-1's
+            # value — each directed link verified individually.
+            ring_out = jax.lax.ppermute(ring, ICI_AXIS, perm)
+        else:
+            psum_out = ramp[:, :1]
+            ring_out = ring
+        return mm_err, hbm_min, hbm_max, psum_out, ring_out
+
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(ICI_AXIS))
+    elems = max(1, (key.hbm_mib * 1024 * 1024) // 4)
+    in_shapes = (
+        jax.ShapeDtypeStruct((n, n), jnp.bfloat16, sharding=rep),
+        jax.ShapeDtypeStruct((n, n), jnp.bfloat16, sharding=rep),
+        jax.ShapeDtypeStruct((elems,), jnp.float32, sharding=rep),
+        jax.ShapeDtypeStruct(
+            (n_dev, key.allreduce_elems), jnp.float32, sharding=shard
+        ),
+        jax.ShapeDtypeStruct((n_dev, 1), jnp.float32, sharding=shard),
+    )
+    fn = jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(ICI_AXIS), P(ICI_AXIS)),
+            out_specs=(
+                P(ICI_AXIS),
+                P(ICI_AXIS),
+                P(ICI_AXIS),
+                P(ICI_AXIS),
+                P(ICI_AXIS),
+            ),
+        )
+    )
+    return fn, in_shapes, (rep, shard)
+
+
+def _get_compiled(
+    key: BatteryKey, devices: Sequence[jax.Device]
+) -> tuple[_CompiledBattery, bool]:
+    """Fetch the compiled battery for ``key`` (compile on miss).
+
+    Returns (battery, cache_hit).  Compile time is measured around the
+    AOT lower+compile; when the backend can't AOT-compile a sharded
+    program the jitted callable is kept and the first execution carries
+    the compile (the timing split then attributes it to the execute
+    phase of the cold call — still correct for the cache-hit story,
+    since warm calls skip tracing either way)."""
+    with _LOCK:
+        cached = _CACHE.get(key)
+        if cached is not None:
+            _STATS["compile_cache_hits"] += 1
+            return cached, True
+    # Compile outside the lock: a 30 s XLA compile must not serialize
+    # unrelated topologies.  A racing duplicate compile is benign — last
+    # writer wins, both executables are identical.
+    mesh = Mesh(np.asarray(list(devices)), (ICI_AXIS,))
+    t0 = time.perf_counter()
+    fn, in_shapes, shardings = _build_battery_fn(key, mesh)
+    aot = False
+    try:
+        fn = fn.lower(*in_shapes).compile()
+        aot = True
+    except Exception as e:  # noqa: BLE001 — jit fallback keeps the fusion
+        logger.info(
+            "AOT compile of fused battery unavailable (%s); "
+            "using jit-on-first-call",
+            e,
+        )
+    compile_ms = (time.perf_counter() - t0) * 1e3
+    battery = _CompiledBattery(
+        key=key,
+        mesh=mesh,
+        fn=fn,
+        aot=aot,
+        compile_ms=compile_ms,
+        input_shardings=shardings,
+    )
+    with _LOCK:
+        _CACHE[key] = battery
+        _STATS["compile_cache_misses"] += 1
+        _STATS["last_compile_ms"] = compile_ms
+    return battery, False
+
+
+def _build_inputs(key: BatteryKey, battery: _CompiledBattery):
+    rep, shard = battery.input_shardings
+    n, n_dev = key.matmul_n, key.device_count
+    elems = max(1, (key.hbm_mib * 1024 * 1024) // 4)
+    a = jax.device_put(jnp.full((n, n), 0.5, jnp.bfloat16), rep)
+    b = jax.device_put(jnp.full((n, n), 1.0 / n, jnp.bfloat16), rep)
+    x = jax.device_put(jnp.zeros((elems,), jnp.float32), rep)
+    ramp_host = np.repeat(
+        np.arange(1.0, n_dev + 1.0, dtype=np.float32)[:, None],
+        key.allreduce_elems,
+        axis=1,
+    )
+    ramp = jax.make_array_from_callback(
+        ramp_host.shape, shard, lambda idx: ramp_host[idx]
+    )
+    ring_host = np.arange(n_dev, dtype=np.float32)[:, None]
+    ring = jax.make_array_from_callback(
+        ring_host.shape, shard, lambda idx: ring_host[idx]
+    )
+    return a, b, x, ramp, ring
+
+
+def _local_shard_rows(out) -> list[tuple[int, np.ndarray]]:
+    """(global row index, values) for every locally-addressable shard —
+    under multi-process jax.distributed each host verifies its own
+    chips' outputs; single-process sees all of them."""
+    rows: list[tuple[int, np.ndarray]] = []
+    for s in out.addressable_shards:
+        start = s.index[0].start or 0
+        vals = np.asarray(s.data)
+        vals = vals.reshape(vals.shape[0], -1)  # row-major, ≥1 col
+        for off in range(vals.shape[0]):
+            rows.append((start + off, vals[off]))
+    return rows
+
+
+def run_fused_battery(
+    devices: Sequence[jax.Device],
+    matmul_n: int = 4096,
+    hbm_mib: int = 1024,
+    allreduce_elems: int = 1 << 20,
+    skip_ici: bool = False,
+) -> list[CheckResult]:
+    """Run the fused battery; returns the mxu_matmul / hbm_bandwidth
+    (+ ici_allreduce / ici_ring) CheckResults.
+
+    Device enumeration stays with the caller (run_host_probe) — nothing
+    here can run without devices, and the inventory check must publish
+    even when the battery can't compile.  Raises on any infrastructure
+    fault; the caller falls back to the unfused battery."""
+    devs = list(devices)
+    n_dev = len(devs)
+    if matmul_n & (matmul_n - 1):
+        raise ValueError(
+            f"fused battery needs power-of-two matmul_n, got {matmul_n}"
+        )
+    key = battery_key(devs, matmul_n, hbm_mib, allreduce_elems, skip_ici)
+    battery, cache_hit = _get_compiled(key, devs)
+
+    inputs = _build_inputs(key, battery)
+    t0 = time.perf_counter()
+    mm_err, hbm_min, hbm_max, psum_out, ring_out = battery.fn(*inputs)
+    # Host readback forces execution (block_until_ready is not
+    # trustworthy on every backend — see probes._sync_readback); reading
+    # the verification scalars IS the sync.
+    mm_rows = _local_shard_rows(mm_err)
+    hbm_min_rows = _local_shard_rows(hbm_min)
+    hbm_max_rows = _local_shard_rows(hbm_max)
+    psum_rows = _local_shard_rows(psum_out)
+    ring_rows = _local_shard_rows(ring_out)
+    execute_ms = (time.perf_counter() - t0) * 1e3
+    with _LOCK:
+        _STATS["last_execute_ms"] = execute_ms
+
+    battery_metrics = {
+        "fused": 1.0,
+        "battery_cache_hit": 1.0 if cache_hit else 0.0,
+        "battery_compile_ms": 0.0 if cache_hit else battery.compile_ms,
+        "battery_execute_ms": execute_ms,
+    }
+
+    def result(
+        name: str, ok: bool, detail: str, extra: Optional[dict] = None
+    ) -> CheckResult:
+        metrics = dict(battery_metrics)
+        if extra:
+            metrics.update(extra)
+        return CheckResult(name, ok, execute_ms, detail, metrics)
+
+    results: list[CheckResult] = []
+
+    # -- mxu_matmul: every local device's chain must be exactly 0.5 ----
+    bad_mm = [(row, float(v.max())) for row, v in mm_rows if np.any(v != 0.0)]
+    if bad_mm:
+        row, err = bad_mm[0]
+        results.append(
+            result(
+                "mxu_matmul",
+                False,
+                f"matmul result mismatch on device {row}: max abs error "
+                f"{err} from expected 0.5 over {MATMUL_CHAIN_ITERS} "
+                f"chained matmuls (n={matmul_n})",
+                {"n": float(matmul_n), "iters": float(MATMUL_CHAIN_ITERS)},
+            )
+        )
+    else:
+        results.append(
+            result(
+                "mxu_matmul",
+                True,
+                f"exact over {MATMUL_CHAIN_ITERS} chained matmuls "
+                f"(n={matmul_n}) on {len(mm_rows)} device(s); fused "
+                "battery (throughput unmeasured)",
+                {"n": float(matmul_n), "iters": float(MATMUL_CHAIN_ITERS)},
+            )
+        )
+
+    # -- hbm_bandwidth: chained value == iteration count everywhere ----
+    expected = float(HBM_CHAIN_ITERS)
+    bad_hbm = [
+        (row, float(v[0]))
+        for rows in (hbm_min_rows, hbm_max_rows)
+        for row, v in rows
+        if float(v[0]) != expected
+    ]
+    if bad_hbm:
+        row, got = bad_hbm[0]
+        results.append(
+            result(
+                "hbm_bandwidth",
+                False,
+                f"stream content mismatch on device {row}: expected "
+                f"{expected}, got {got}",
+                {"mib": float(hbm_mib), "iters": float(HBM_CHAIN_ITERS)},
+            )
+        )
+    else:
+        results.append(
+            result(
+                "hbm_bandwidth",
+                True,
+                f"content exact over {hbm_mib} MiB x {HBM_CHAIN_ITERS} "
+                "passes; fused battery (bandwidth unmeasured)",
+                {"mib": float(hbm_mib), "iters": float(HBM_CHAIN_ITERS)},
+            )
+        )
+
+    if skip_ici:
+        return results
+
+    # -- ici_allreduce ------------------------------------------------
+    if n_dev < 2:
+        results.append(
+            result(
+                "ici_allreduce",
+                True,
+                "single device; no ICI to probe",
+                {"devices": float(n_dev)},
+            )
+        )
+    else:
+        want = (n_dev + 1) / 2.0  # fixed point of the chained psum
+        bad_psum = [
+            (row, float(v[0])) for row, v in psum_rows if float(v[0]) != want
+        ]
+        if bad_psum:
+            row, got = bad_psum[0]
+            results.append(
+                result(
+                    "ici_allreduce",
+                    False,
+                    f"psum mismatch on device {row}: expected {want}, "
+                    f"got {got}",
+                    {"devices": float(n_dev), "iters": float(PSUM_ROUNDS)},
+                )
+            )
+        else:
+            results.append(
+                result(
+                    "ici_allreduce",
+                    True,
+                    f"psum over {n_dev} devices exact ({PSUM_ROUNDS} "
+                    "rounds); fused battery (bus bandwidth unmeasured)",
+                    {"devices": float(n_dev), "iters": float(PSUM_ROUNDS)},
+                )
+            )
+
+    # -- ici_ring -----------------------------------------------------
+    if n_dev < 2:
+        results.append(
+            result(
+                "ici_ring",
+                True,
+                "single device; no links to probe",
+                {"devices": float(n_dev)},
+            )
+        )
+    else:
+        bad_ring = [
+            (row, float(v[0]))
+            for row, v in ring_rows
+            if float(v[0]) != float((row - 1) % n_dev)
+        ]
+        if bad_ring:
+            row, got = bad_ring[0]
+            results.append(
+                result(
+                    "ici_ring",
+                    False,
+                    f"link {(row - 1) % n_dev}->{row} delivered {got}, "
+                    f"expected {float((row - 1) % n_dev)}",
+                    {
+                        "devices": float(n_dev),
+                        "bad_links": float(len(bad_ring)),
+                    },
+                )
+            )
+        else:
+            results.append(
+                result(
+                    "ici_ring",
+                    True,
+                    f"all {len(ring_rows)} locally-received ring link(s) "
+                    f"verified ({n_dev}-device ring)",
+                    {"devices": float(n_dev)},
+                )
+            )
+    return results

@@ -45,6 +45,14 @@ logger = get_logger(__name__)
 # One ICI mesh axis: a slice is one torus; the probe reduces over all of it.
 ICI_AXIS = "ici"
 
+# jax moved shard_map out of jax.experimental at different points across
+# the versions this library runs against; resolve once, newest spelling
+# first, so every probe (and the fused battery) shares one symbol.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
 
 @dataclass
 class CheckResult:
@@ -578,7 +586,7 @@ def ici_allreduce_probe(
         return jax.lax.psum(x, ICI_AXIS)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=P(ICI_AXIS), out_specs=P(ICI_AXIS)
         )
     )
@@ -662,7 +670,7 @@ def ici_ring_probe(
         return jax.lax.ppermute(x, ICI_AXIS, perm)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=P(ICI_AXIS), out_specs=P(ICI_AXIS)
         )
     )
@@ -879,7 +887,7 @@ def dcn_collective_probe(
             lambda idx: host[idx],
         )
         fn = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=P("dcn"), out_specs=P())
+            shard_map(body, mesh=mesh, in_specs=P("dcn"), out_specs=P())
         )
         counts = np.asarray(
             _addressable_numpy(jax.block_until_ready(fn(x)))
@@ -914,6 +922,13 @@ def dcn_collective_probe(
     )
 
 
+def fused_battery_enabled() -> bool:
+    """Fused battery default: on unless K8S_TPU_FUSED_BATTERY disables
+    it (the unfused path is the always-available fallback)."""
+    raw = os.environ.get("K8S_TPU_FUSED_BATTERY", "1").strip().lower()
+    return raw not in ("0", "false", "no", "off")
+
+
 def run_host_probe(
     devices: Optional[Sequence[jax.Device]] = None,
     expected_devices: int = 0,
@@ -928,6 +943,7 @@ def run_host_probe(
     dcn_group: str = "",
     dcn_expected_groups: Optional[Sequence[str]] = None,
     on_check=None,
+    fused: Optional[bool] = None,
 ) -> list[CheckResult]:
     """Run the full probe battery; returns every check's result.
 
@@ -935,6 +951,15 @@ def run_host_probe(
     matmuls, 1 GiB HBM stream, ≥50 ms device time per probe) so the
     reported TFLOPS/GB/s figures are comparable to chip spec and usable
     as health floors; tests/CI pass small overrides.
+
+    ``fused`` selects the single-dispatch fused battery
+    (health.fused: one compiled XLA program for matmul + HBM + ICI,
+    topology-keyed compile cache).  ``None`` resolves the
+    K8S_TPU_FUSED_BATTERY env default (on); any fused-path fault falls
+    back to the unfused probes below, so fusing can only ever add
+    speed, never subtract coverage.  Fused checks carry no throughput
+    figures (a single dispatch can't run the sustained estimator) —
+    downstream floors treat that like ``timing_inconclusive``.
 
     ``on_check`` (optional ``CheckResult -> None``) is invoked as each
     check completes — a progress/liveness hook for callers running the
@@ -966,36 +991,75 @@ def run_host_probe(
     add(device_inventory(devs, expected_devices))
     if not devs:
         return results
-    # Single-device probes must run on a device THIS process addresses:
-    # under jax.distributed the global device list spans hosts, and
-    # device_put onto a non-addressable device raises.  The process
-    # index must come from the device's own backend — the DEFAULT
-    # backend can be a different registered plugin with its own
-    # (single-process) view.
-    local = [d for d in devs if d.process_index == d.client.process_index()]
-    probe_dev = local[0] if local else devs[0]
-    add(
-        matmul_probe(
-            probe_dev, n=matmul_n, min_time_s=min_time_s, max_iters=max_iters
-        )
-    )
-    add(
-        hbm_bandwidth_probe(
-            probe_dev, mib=hbm_mib, min_time_s=min_time_s, max_iters=max_iters
-        )
-    )
-    if not skip_ici:
-        add(
-            ici_allreduce_probe(
+    if fused is None:
+        fused = fused_battery_enabled()
+    fused_checks: Optional[list[CheckResult]] = None
+    if fused:
+        try:
+            from k8s_operator_libs_tpu.health.fused import run_fused_battery
+
+            fused_checks = run_fused_battery(
                 devs,
-                per_device_elems=allreduce_elems,
+                matmul_n=matmul_n,
+                hbm_mib=hbm_mib,
+                allreduce_elems=allreduce_elems,
+                skip_ici=skip_ici,
+            )
+        except Exception as e:  # noqa: BLE001 — unfused is the fallback
+            from k8s_operator_libs_tpu.health.fused import record_fallback
+
+            record_fallback()
+            logger.warning(
+                "fused probe battery failed (%s); falling back to the "
+                "unfused probes",
+                e,
+            )
+            fused_checks = None
+    if fused_checks is not None:
+        for check in fused_checks:
+            add(check)
+    else:
+        # Single-device probes must run on a device THIS process
+        # addresses: under jax.distributed the global device list spans
+        # hosts, and device_put onto a non-addressable device raises.
+        # The process index must come from the device's own backend —
+        # the DEFAULT backend can be a different registered plugin with
+        # its own (single-process) view.
+        local = [
+            d for d in devs if d.process_index == d.client.process_index()
+        ]
+        probe_dev = local[0] if local else devs[0]
+        add(
+            matmul_probe(
+                probe_dev,
+                n=matmul_n,
                 min_time_s=min_time_s,
                 max_iters=max_iters,
             )
         )
-        add(ici_ring_probe(devs))
-        if deep:
-            add(ici_ring_attention_probe(devs))
+        add(
+            hbm_bandwidth_probe(
+                probe_dev,
+                mib=hbm_mib,
+                min_time_s=min_time_s,
+                max_iters=max_iters,
+            )
+        )
+        if not skip_ici:
+            add(
+                ici_allreduce_probe(
+                    devs,
+                    per_device_elems=allreduce_elems,
+                    min_time_s=min_time_s,
+                    max_iters=max_iters,
+                )
+            )
+            add(ici_ring_probe(devs))
+    # The deep soak stays unfused: it is an optional post-incident /
+    # periodic check with its own workload-shaped program, not part of
+    # the quick gate the fusion accelerates.
+    if not skip_ici and deep:
+        add(ici_ring_attention_probe(devs))
     if dcn_peers:
         add(dcn_reachability_probe(dcn_peers))
     if dcn_expected_groups:

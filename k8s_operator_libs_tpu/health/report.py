@@ -21,7 +21,9 @@ from k8s_operator_libs_tpu.health.probes import CheckResult
 
 # Every check `run_host_probe` can emit, in emission order
 # (ici_ring_attention only with deep=True; dcn_reachability only when
-# the agent is configured with DCN peers).
+# the agent is configured with DCN peers).  The fused battery
+# (health.fused) emits the same names with identical pass/fail
+# semantics — only the throughput side-channel metrics differ.
 HEALTH_CHECKS_ALL = (
     "device_enumeration",
     "mxu_matmul",
@@ -31,6 +33,23 @@ HEALTH_CHECKS_ALL = (
     "ici_ring_attention",
     "dcn_reachability",
 )
+
+
+def fused_battery_telemetry(checks) -> dict[str, float]:
+    """Battery telemetry carried in fused-check metrics, or {} when the
+    report came from the unfused path.
+
+    Keys (health.fused): ``battery_cache_hit``, ``battery_compile_ms``,
+    ``battery_execute_ms`` — the cold-vs-warm split per report, consumed
+    by the status CLI and the bench."""
+    for c in checks:
+        if c.metrics.get("fused"):
+            return {
+                k: v
+                for k, v in c.metrics.items()
+                if k == "fused" or k.startswith("battery_")
+            }
+    return {}
 
 
 @dataclass

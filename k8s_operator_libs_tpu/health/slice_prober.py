@@ -43,6 +43,12 @@ DEFAULT_MAX_REPORT_AGE_S = 600.0
 class LocalDeviceProber:
     """Run the probe battery in-process on locally-visible devices."""
 
+    # Real XLA device work (seconds even with the fused battery's warm
+    # path on big topologies): ValidationManager dispatches this prober
+    # to a worker thread so the battery never blocks a reconcile tick —
+    # validation of group N+1 overlaps uncordon of group N.
+    async_probe = True
+
     def __init__(
         self,
         devices: Optional[Sequence[jax.Device]] = None,
@@ -50,12 +56,17 @@ class LocalDeviceProber:
         matmul_n: int = 4096,
         hbm_mib: int = 1024,
         allreduce_elems: int = 1 << 20,
+        # None resolves the K8S_TPU_FUSED_BATTERY env default (on): one
+        # compiled XLA dispatch for the whole battery, compile cached by
+        # topology (health.fused), unfused probes as automatic fallback.
+        fused: Optional[bool] = None,
     ) -> None:
         self.devices = list(devices) if devices is not None else None
         self.expected_devices = expected_devices
         self.matmul_n = matmul_n
         self.hbm_mib = hbm_mib
         self.allreduce_elems = allreduce_elems
+        self.fused = fused
 
     def probe(self, group: UpgradeGroup) -> ProbeResult:
         checks = run_host_probe(
@@ -64,6 +75,7 @@ class LocalDeviceProber:
             matmul_n=self.matmul_n,
             hbm_mib=self.hbm_mib,
             allreduce_elems=self.allreduce_elems,
+            fused=self.fused,
         )
         failed = [c for c in checks if not c.ok]
         if failed:

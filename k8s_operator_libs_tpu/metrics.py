@@ -295,6 +295,38 @@ class UpgradeMetrics:
             "budget_parallel_used",
             "Groups currently holding an in-progress budget claim",
         )
+        # Fused probe-battery surface (health.fused; absent when the
+        # controller never probed in-process, e.g. NodeReportProber-only
+        # deployments where the agents run the battery instead).
+        r.describe(
+            "probe_battery_seconds",
+            "Last fused-battery phase duration (compile is 0 on a "
+            "topology-keyed cache hit)",
+            "phase",
+        )
+        r.describe(
+            "probe_battery_cache_hits_total",
+            "Fused-battery dispatches served by the topology-keyed "
+            "compile cache",
+        )
+        r.describe(
+            "probe_battery_cache_misses_total",
+            "Fused-battery compiles (first sight of a topology key)",
+        )
+        r.describe(
+            "probe_battery_fallbacks_total",
+            "Fused-battery failures that fell back to the unfused probes",
+        )
+        r.describe(
+            "probe_battery_cached_programs",
+            "Distinct topology keys currently held in the compile cache",
+        )
+        r.describe(
+            "validation_wall_seconds",
+            "Wall-clock of each slice's last passed validation gate "
+            "(stamp -> healthy verdict, including async probe queueing)",
+            "slice",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -367,6 +399,47 @@ class UpgradeMetrics:
                     "api_requests_per_tick", total - self._last_api_total
                 )
             self._last_api_total = total
+        # Fused-battery surface: import lazily so a controller built
+        # without jax (pure NodeReportProber aggregation) still exports
+        # everything else.
+        try:
+            from k8s_operator_libs_tpu.health.fused import battery_stats
+        except Exception:  # noqa: BLE001 — jax/libtpu absent is fine
+            battery_stats = None
+        if battery_stats is not None:
+            bstats = battery_stats()
+            if bstats.get("compile_cache_hits") or bstats.get(
+                "compile_cache_misses"
+            ):
+                r.set(
+                    "probe_battery_seconds",
+                    bstats.get("last_compile_ms", 0.0) / 1000.0,
+                    phase="compile",
+                )
+                r.set(
+                    "probe_battery_seconds",
+                    bstats.get("last_execute_ms", 0.0) / 1000.0,
+                    phase="execute",
+                )
+                r.set(
+                    "probe_battery_cache_hits_total",
+                    bstats.get("compile_cache_hits", 0),
+                )
+                r.set(
+                    "probe_battery_cache_misses_total",
+                    bstats.get("compile_cache_misses", 0),
+                )
+                r.set(
+                    "probe_battery_fallbacks_total",
+                    bstats.get("fallbacks", 0),
+                )
+                r.set(
+                    "probe_battery_cached_programs",
+                    bstats.get("cached_programs", 0),
+                )
+        vm = getattr(manager, "validation_manager", None)
+        for gid, wall in getattr(vm, "validation_wall_s", {}).items():
+            r.set("validation_wall_seconds", wall, slice=gid)
         informer = getattr(client, "informer", None)
         if informer is not None and hasattr(informer, "stats"):
             istats = informer.stats

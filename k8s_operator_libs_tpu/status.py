@@ -50,6 +50,27 @@ SHARDED_METRIC_KEYS = {
 }
 
 
+# Controller /metrics series → status keys for the probe-battery section
+# (unlabeled series only; probe_battery_seconds{phase=...} and
+# validation_wall_seconds{slice=...} are parsed label-aware below).
+BATTERY_METRIC_KEYS = {
+    "probe_battery_cache_hits_total": "cacheHits",
+    "probe_battery_cache_misses_total": "cacheMisses",
+    "probe_battery_fallbacks_total": "fallbacks",
+    "probe_battery_cached_programs": "cachedPrograms",
+}
+
+
+def _metrics_text(metrics_url: str, fetch=None) -> str:
+    """Fetch the exposition text; ``fetch`` is injectable for tests."""
+    if fetch is None:
+        from urllib.request import urlopen
+
+        with urlopen(metrics_url, timeout=5) as resp:
+            return resp.read().decode()
+    return fetch(metrics_url)
+
+
 def sharded_health(metrics_url: str, fetch=None) -> Optional[dict]:
     """Shard health from the controller's /metrics exposition.
 
@@ -60,13 +81,7 @@ def sharded_health(metrics_url: str, fetch=None) -> Optional[dict]:
     an ``{"error": ...}`` dict when the endpoint is unreachable.
     ``fetch`` is injectable for tests."""
     try:
-        if fetch is None:
-            from urllib.request import urlopen
-
-            with urlopen(metrics_url, timeout=5) as resp:
-                text = resp.read().decode()
-        else:
-            text = fetch(metrics_url)
+        text = _metrics_text(metrics_url, fetch)
     except Exception as e:  # noqa: BLE001 — status must render regardless
         return {"error": f"metrics unreachable: {e}"}
     out: dict = {}
@@ -84,6 +99,50 @@ def sharded_health(metrics_url: str, fetch=None) -> Optional[dict]:
             out[key] = float(value)
         except ValueError:
             continue
+    return out or None
+
+
+def battery_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Fused probe-battery + validation-gate health from /metrics.
+
+    Returns None when the battery family is absent (controller never
+    probed in-process — e.g. agents run the battery instead), an
+    ``{"error": ...}`` dict when the endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    walls: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "probe_battery_seconds":
+            if 'phase="compile"' in labels:
+                out["compileSeconds"] = val
+            elif 'phase="execute"' in labels:
+                out["executeSeconds"] = val
+        elif short == "validation_wall_seconds":
+            gid = labels.split('slice="', 1)
+            if len(gid) == 2:
+                walls[gid[1].split('"', 1)[0]] = val
+        else:
+            key = BATTERY_METRIC_KEYS.get(short)
+            if key is not None:
+                out[key] = val
+    if walls:
+        out["validationWallSeconds"] = walls
     return out or None
 
 
@@ -263,6 +322,9 @@ def gather(
         sharded = sharded_health(metrics_url, fetch=metrics_fetch)
         if sharded is not None:
             out["shardedReconcile"] = sharded
+        battery = battery_health(metrics_url, fetch=metrics_fetch)
+        if battery is not None:
+            out["probeBattery"] = battery
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -392,6 +454,29 @@ def render(status: dict) -> str:
                 f"errors {int(sharded.get('shardErrors', 0))}, "
                 f"fenced {int(sharded.get('shardFenced', 0))}"
             )
+    battery = status.get("probeBattery")
+    if battery is not None:
+        lines.append("")
+        if "error" in battery:
+            lines.append(f"probe battery: {battery['error']}")
+        else:
+            lines.append(
+                f"probe battery: compile "
+                f"{battery.get('compileSeconds', 0.0):.3f}s execute "
+                f"{battery.get('executeSeconds', 0.0):.3f}s | cache "
+                f"{int(battery.get('cacheHits', 0))} hit(s) "
+                f"{int(battery.get('cacheMisses', 0))} miss(es) "
+                f"({int(battery.get('cachedPrograms', 0))} cached), "
+                f"fallbacks {int(battery.get('fallbacks', 0))}"
+            )
+            walls = battery.get("validationWallSeconds") or {}
+            if walls:
+                lines.append(
+                    "  validation wall: "
+                    + ", ".join(
+                        f"{gid}={s:.1f}s" for gid, s in sorted(walls.items())
+                    )
+                )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")

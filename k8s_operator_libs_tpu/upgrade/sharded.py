@@ -424,10 +424,22 @@ class BudgetLedger:
         # recording dcn_of with the knob off would make try_claim deny
         # same-DCN groups the admission path deliberately allows.
         dcn_anti_affinity = bool(getattr(policy, "dcn_anti_affinity", False))
+        pipeline = bool(getattr(policy, "pipeline_validation", False))
         charges: dict[str, int] = {}
         dcn_of: dict[str, str] = {}
         for st in IN_PROGRESS_STATES:
             for group in state.groups_in(st):
+                if (
+                    pipeline
+                    and st == UpgradeState.VALIDATION_REQUIRED
+                    and manager._group_validating_schedulable(group)
+                ):
+                    # Pipelined gate with every host back in service: the
+                    # admission path released this claim at optimistic
+                    # uncordon — re-charging it here would silently undo
+                    # the pipeline every full resync (mirrors the local
+                    # slot math's _in_progress_units(pipeline=True)).
+                    continue
                 charges[group.id] = 1 if unit == "slice" else group.size()
                 if (
                     dcn_anti_affinity

@@ -654,6 +654,27 @@ class ClusterUpgradeStateManager:
         )
         # Pipelined validation re-cordons a slice whose gate fails.
         self.validation_manager.recordon_on_timeout = pipeline
+        if pipeline and self.budget_ledger is not None:
+            # The pipelined gate released the group's ledger claim at
+            # optimistic uncordon; a timeout takes the hosts back out of
+            # service, so force the charge back on (past the caps if the
+            # freed slot was already re-claimed — the unavailability is
+            # a fact, not an admission request).
+            _ledger = self.budget_ledger
+            _unit = self._unavailability_unit(policy)
+
+            def _recharge_on_recordon(group):
+                _ledger.try_claim(
+                    group.id,
+                    1 if _unit == "slice" else group.size(),
+                    force=True,
+                )
+
+            self.validation_manager.on_pipeline_recordon = (
+                _recharge_on_recordon
+            )
+        else:
+            self.validation_manager.on_pipeline_recordon = None
 
         # The pod manager's eviction-escalation ladder derives from the
         # drain spec (PodDeletionSpec carries no ladder knobs of its own).
@@ -1055,6 +1076,14 @@ class ClusterUpgradeStateManager:
                                 if key not in m.node.annotations
                             ]
                         )
+                        if self.budget_ledger is not None:
+                            # Hosts are schedulable while the gate runs:
+                            # free the fleet-wide charge so the next
+                            # slice's upgrade overlaps this validation
+                            # (the local-slot path does the same via
+                            # _group_validating_schedulable).  A timeout
+                            # re-charges through on_pipeline_recordon.
+                            self.budget_ledger.release(group.id)
                     self.provider.change_nodes_upgrade_state(
                         group.nodes, UpgradeState.VALIDATION_REQUIRED
                     )

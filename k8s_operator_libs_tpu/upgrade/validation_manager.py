@@ -123,6 +123,13 @@ class ValidationManager:
         # manager; set per apply_state from the policy.
         self.cordon_manager = None
         self.recordon_on_timeout = False
+        # Sharded-mode companion to recordon_on_timeout: the pipelined
+        # gate released the group's ledger claim at optimistic uncordon
+        # (its hosts were serving again); when the gate times out the
+        # hosts come back OUT of service, so the manager wires this to a
+        # forced ledger re-claim — keeping unavailable_used honest until
+        # the next full resync re-baselines from FAILED state.
+        self.on_pipeline_recordon = None
         # Rollback workers evicting the readmitted workload (joinable via
         # wait_idle, test/bench convenience).
         self._tracker = WorkerTracker()
@@ -162,6 +169,28 @@ class ValidationManager:
         self.fence = None
         self.term_fence = None
         self.rung_store = None
+        # -- async (pipelined) probing ----------------------------------
+        # A prober that marks itself ``async_probe = True`` (the fused
+        # device battery — real XLA work, up to seconds even warm) runs
+        # on a worker thread instead of on the reconcile thread:
+        # validate() schedules the probe on first call and consumes the
+        # verdict on a later pass, so one slice's battery never blocks
+        # the tick — group N+1's validation overlaps group N's uncordon
+        # (the existing pipeline slot math already keeps maxUnavailable
+        # honest for VALIDATION_REQUIRED groups).  Cheap probers
+        # (annotation aggregation, pod-Ready) stay synchronous.
+        self._probe_lock = threading.Lock()
+        self._probe_inflight: set[str] = set()
+        self._probe_verdicts: dict[str, ProbeResult] = {}
+        # Monotonically-increasing epoch per group: bumped whenever the
+        # group leaves validation (timeout), so a verdict from a probe
+        # scheduled before the exit can never pass a LATER gate entry.
+        self._probe_epoch: dict[str, int] = {}
+        # Gate wall-clock per group: first validate() call -> gate pass.
+        # Terminal wall times land in validation_wall_s (metrics/bench:
+        # the per-slice validation wall-time the fused battery shrinks).
+        self._gate_started: dict[str, float] = {}
+        self.validation_wall_s: dict[str, float] = {}
 
     # -- durable rollback clocks --------------------------------------------
 
@@ -274,16 +303,35 @@ class ValidationManager:
     def validate(self, group: UpgradeGroup) -> bool:
         """Probe the group; on failure run the timeout clock
         (validation_manager.go:94-115 lifted to groups).  Returns True when
-        validation passed and the group may advance."""
+        validation passed and the group may advance.
+
+        Probers with ``async_probe = True`` are dispatched to a worker
+        thread (see ``_probe_inflight`` in __init__): this call then
+        returns False while the probe runs and consumes the verdict on a
+        later reconcile pass — the timeout clock keeps ticking against
+        the same start annotation either way."""
         if self.prober is None:
             return True
-        result = self.prober.probe(group)
+        self._gate_started.setdefault(group.id, time.monotonic())
+        if getattr(self.prober, "async_probe", False):
+            result = self._async_probe_result(group)
+            if result is None:
+                # In flight (or just scheduled): the gate stays open and
+                # the timeout clock keeps running — a hung battery must
+                # still fail the upgrade at the deadline.
+                self._handle_timeout(group)
+                return False
+        else:
+            result = self.prober.probe(group)
         if not result.healthy:
             logger.info("group %s validation pending: %s", group.id, result.detail)
             self.last_rejection[group.id] = result.detail
             self._handle_timeout(group)
             return False
         self.last_rejection.pop(group.id, None)
+        started = self._gate_started.pop(group.id, None)
+        if started is not None:
+            self.validation_wall_s[group.id] = time.monotonic() - started
         # Passed: clear the start-time annotation.
         self.provider.change_nodes_upgrade_annotation(
             [
@@ -296,6 +344,60 @@ class ValidationManager:
         )
         return True
 
+    def _async_probe_result(self, group: UpgradeGroup) -> Optional[ProbeResult]:
+        """Consume a completed async verdict, or schedule a probe worker
+        and return None while one is (now) in flight.
+
+        An unhealthy verdict is consumed ONCE (the next pass schedules a
+        fresh probe) — same retry cadence as the sync path, one probe
+        per rejection, but off the reconcile thread."""
+        with self._probe_lock:
+            if group.id in self._probe_verdicts:
+                return self._probe_verdicts.pop(group.id)
+            if group.id in self._probe_inflight:
+                return None
+            self._probe_inflight.add(group.id)
+            epoch = self._probe_epoch.get(group.id, 0)
+
+        def _probe() -> None:
+            try:
+                result = self.prober.probe(group)
+            except Exception as e:  # noqa: BLE001 — a crashed probe rejects
+                result = ProbeResult(False, f"prober raised: {e}")
+            with self._probe_lock:
+                self._probe_inflight.discard(group.id)
+                if self._probe_epoch.get(group.id, 0) == epoch:
+                    self._probe_verdicts[group.id] = result
+                # else: the group left validation (timeout) while this
+                # probe ran — its verdict must not leak into a later
+                # gate entry for the same group.
+
+        try:
+            self._tracker.spawn(_probe, name=f"validation-probe-{group.id}")
+        except Exception as e:  # noqa: BLE001 — retry next pass
+            # A failed spawn must not strand the in-flight claim (the
+            # same leak shape as the rollback-spawn fix below); unlike
+            # the rollback path this one swallows the error — validate()
+            # runs on the reconcile thread and simply retries next pass.
+            with self._probe_lock:
+                self._probe_inflight.discard(group.id)
+            logger.warning(
+                "failed to spawn validation probe for group %s: %s",
+                group.id,
+                e,
+            )
+        return None
+
+    def _discard_probe_state(self, group_id: str) -> None:
+        """The group left validation: invalidate any in-flight probe
+        (epoch bump) and drop an unconsumed verdict + the gate clock."""
+        with self._probe_lock:
+            self._probe_epoch[group_id] = (
+                self._probe_epoch.get(group_id, 0) + 1
+            )
+            self._probe_verdicts.pop(group_id, None)
+        self._gate_started.pop(group_id, None)
+
     def _handle_timeout(self, group: UpgradeGroup) -> None:
         key = self.keys.validation_start_time_annotation
         now = int(time.time())
@@ -305,8 +407,11 @@ class ValidationManager:
         if self.timeout_seconds and now > start + self.timeout_seconds:
             logger.info("group %s validation timed out -> failed", group.id)
             # The group leaves validation: a stale rejection must not be
-            # attributed to a future stall in a different phase.
+            # attributed to a future stall in a different phase, and a
+            # still-running async probe's verdict must not pass a future
+            # re-entry of the gate.
             self.last_rejection.pop(group.id, None)
+            self._discard_probe_state(group.id)
             if self.recordon_on_timeout and self.cordon_manager is not None:
                 # Optimistic-uncordon rollback: the workload was
                 # readmitted before the gate; an unvalidated slice must
@@ -317,6 +422,8 @@ class ValidationManager:
                 # no drain processor to pick this up later).
                 self.cordon_manager.cordon_nodes(group.nodes)
                 self._schedule_rollback_eviction(group)
+                if self.on_pipeline_recordon is not None:
+                    self.on_pipeline_recordon(group)
             for node in group.nodes:
                 log_event(
                     self.event_recorder,
@@ -502,5 +609,5 @@ class ValidationManager:
             self._schedule_rollback_eviction(group)
 
     def wait_idle(self, timeout_s: float = 30.0) -> bool:
-        """Join outstanding rollback-eviction workers."""
+        """Join outstanding workers (rollback evictions + async probes)."""
         return self._tracker.wait_idle(timeout_s)

@@ -31,6 +31,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# shard_map's import path moved across the jax versions this library
+# runs against; resolve the newest spelling first (same shim as
+# health.probes — duplicated to keep workloads free of health imports).
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
 NEG_INF = -1e30
 
 
@@ -39,7 +47,9 @@ def _pvary(x, axis_name):
     lax.pvary to lax.pcast(..., to='varying') in newer jax)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis_name,), to="varying")
-    return jax.lax.pvary(x, axis_name)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x  # older jax: shard_map values are implicitly varying
 
 
 def _block_attention(q, k, v, mask):
@@ -156,7 +166,7 @@ def make_ring_attention(
     spec = P(None, axis_name, None, None)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             partial(ring_attention_sharded, axis_name=axis_name,
                     causal=causal),
             mesh=mesh,

@@ -1,0 +1,467 @@
+"""Fused probe battery + pipelined validation.
+
+Tentpole pins (ISSUE 7):
+
+- the fused single-dispatch battery produces the SAME CheckResult set
+  (names + verdicts) as the unfused probes, across topologies;
+- the compiled battery is cached by topology key — same topology hits,
+  different device count / battery version misses — with the
+  cold-vs-warm split recorded in the check metadata;
+- any fused-path fault falls back to the unfused probes (counted);
+- an ``async_probe`` prober runs off the reconcile thread, stale
+  verdicts are epoch-guarded across gate timeouts, and the sharded
+  budget ledger releases a pipelined validating slice's claim at
+  optimistic uncordon, skips it at resync re-baseline, and force
+  re-charges it when the gate times out.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.health import fused, run_host_probe
+from k8s_operator_libs_tpu.health.fused import (
+    battery_key,
+    battery_stats,
+    reset_battery_cache,
+    run_fused_battery,
+)
+from k8s_operator_libs_tpu.health.report import fused_battery_telemetry
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    ProbeResult,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.sharded import BudgetLedger
+from k8s_operator_libs_tpu.upgrade.types import NodeUpgradeState, UpgradeGroup
+from k8s_operator_libs_tpu.upgrade.validation_manager import ValidationManager
+from tests.fixtures import (
+    DRIVER_LABELS,
+    NAMESPACE,
+    ClusterFixture,
+    make_node,
+    state_of,
+)
+
+KEYS = UpgradeKeys()
+SMALL = dict(matmul_n=128, hbm_mib=1, allreduce_elems=128)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    reset_battery_cache()
+    yield
+    reset_battery_cache()
+
+
+def _verdicts(checks):
+    return [(c.name, c.ok) for c in checks]
+
+
+# --- fused/unfused parity ---------------------------------------------------
+
+
+def test_fused_parity_full_mesh(cpu_devices):
+    fused_checks = run_host_probe(cpu_devices, fused=True, **SMALL)
+    unfused = run_host_probe(cpu_devices, fused=False, **SMALL)
+    assert _verdicts(fused_checks) == _verdicts(unfused)
+    assert all(ok for _, ok in _verdicts(fused_checks))
+
+
+def test_fused_parity_single_device(cpu_devices):
+    fused_checks = run_host_probe(cpu_devices[:1], fused=True, **SMALL)
+    unfused = run_host_probe(cpu_devices[:1], fused=False, **SMALL)
+    assert _verdicts(fused_checks) == _verdicts(unfused)
+
+
+def test_fused_parity_skip_ici(cpu_devices):
+    fused_checks = run_host_probe(
+        cpu_devices, fused=True, skip_ici=True, **SMALL
+    )
+    unfused = run_host_probe(
+        cpu_devices, fused=False, skip_ici=True, **SMALL
+    )
+    assert _verdicts(fused_checks) == _verdicts(unfused)
+    assert [c.name for c in fused_checks] == [
+        "device_enumeration",
+        "mxu_matmul",
+        "hbm_bandwidth",
+    ]
+
+
+def test_fused_parity_expected_devices_mismatch(cpu_devices):
+    fused_checks = run_host_probe(
+        cpu_devices, fused=True, expected_devices=16, **SMALL
+    )
+    unfused = run_host_probe(
+        cpu_devices, fused=False, expected_devices=16, **SMALL
+    )
+    assert _verdicts(fused_checks) == _verdicts(unfused)
+    assert not fused_checks[0].ok  # enumeration mismatch fails either way
+
+
+def test_fused_rejects_non_pow2_matmul(cpu_devices):
+    with pytest.raises(ValueError):
+        run_fused_battery(cpu_devices, matmul_n=100)
+
+
+# --- compile cache keying ---------------------------------------------------
+
+
+def test_cache_cold_then_warm_same_topology(cpu_devices):
+    cold = run_fused_battery(cpu_devices, **SMALL)
+    warm = run_fused_battery(cpu_devices, **SMALL)
+    stats = battery_stats()
+    assert stats["compile_cache_misses"] == 1
+    assert stats["compile_cache_hits"] == 1
+    assert stats["cached_programs"] == 1
+    # Cold/warm split lands in the check metadata.
+    for c in cold:
+        assert c.metrics["battery_cache_hit"] == 0.0
+        assert c.metrics["battery_compile_ms"] > 0.0
+    for c in warm:
+        assert c.metrics["battery_cache_hit"] == 1.0
+        assert c.metrics["battery_compile_ms"] == 0.0
+        assert c.metrics["battery_execute_ms"] > 0.0
+
+
+def test_cache_device_count_misses(cpu_devices):
+    run_fused_battery(cpu_devices, **SMALL)
+    run_fused_battery(cpu_devices[:4], **SMALL)
+    stats = battery_stats()
+    assert stats["compile_cache_misses"] == 2
+    assert stats["cached_programs"] == 2
+    assert battery_key(cpu_devices, 128, 1, 128, False) != battery_key(
+        cpu_devices[:4], 128, 1, 128, False
+    )
+
+
+def test_cache_problem_size_misses(cpu_devices):
+    run_fused_battery(cpu_devices, **SMALL)
+    run_fused_battery(cpu_devices, **{**SMALL, "matmul_n": 256})
+    assert battery_stats()["compile_cache_misses"] == 2
+
+
+def test_cache_battery_version_bump_invalidates(cpu_devices, monkeypatch):
+    run_fused_battery(cpu_devices, **SMALL)
+    monkeypatch.setattr(fused, "BATTERY_VERSION", fused.BATTERY_VERSION + 1)
+    run_fused_battery(cpu_devices, **SMALL)
+    stats = battery_stats()
+    assert stats["compile_cache_misses"] == 2
+    assert stats["compile_cache_hits"] == 0
+
+
+# --- fallback + env knob ----------------------------------------------------
+
+
+def test_fused_fault_falls_back_to_unfused(cpu_devices, monkeypatch):
+    def boom(*a, **k):
+        raise RuntimeError("fused battery exploded")
+
+    monkeypatch.setattr(fused, "run_fused_battery", boom)
+    checks = run_host_probe(cpu_devices, fused=True, **SMALL)
+    # Full unfused battery, all passing — fallback subtracted nothing.
+    assert _verdicts(checks) == _verdicts(
+        run_host_probe(cpu_devices, fused=False, **SMALL)
+    )
+    assert battery_stats()["fallbacks"] == 1
+    assert not any(c.metrics.get("fused") for c in checks)
+
+
+def test_env_knob_disables_fused(cpu_devices, monkeypatch):
+    from k8s_operator_libs_tpu.health.probes import fused_battery_enabled
+
+    monkeypatch.setenv("K8S_TPU_FUSED_BATTERY", "0")
+    assert not fused_battery_enabled()
+    checks = run_host_probe(cpu_devices, **SMALL)
+    assert not any(c.metrics.get("fused") for c in checks)
+    monkeypatch.setenv("K8S_TPU_FUSED_BATTERY", "1")
+    assert fused_battery_enabled()
+
+
+def test_report_telemetry_helper(cpu_devices):
+    fused_checks = run_host_probe(cpu_devices, fused=True, **SMALL)
+    tele = fused_battery_telemetry(fused_checks)
+    assert tele["fused"] == 1.0
+    assert "battery_cache_hit" in tele
+    assert fused_battery_telemetry(
+        run_host_probe(cpu_devices, fused=False, **SMALL)
+    ) == {}
+
+
+# --- async (pipelined) validation ------------------------------------------
+
+
+class GatedProber:
+    """Async prober whose probe blocks until released — models the fused
+    battery running on the worker thread."""
+
+    async_probe = True
+
+    def __init__(self, healthy: bool = True) -> None:
+        self.release = threading.Event()
+        self.calls = 0
+        self.healthy = healthy
+
+    def probe(self, group) -> ProbeResult:
+        self.calls += 1
+        assert self.release.wait(10.0), "probe never released"
+        return ProbeResult(self.healthy, "gated verdict")
+
+
+def _vm(cluster, prober, timeout_seconds=300):
+    provider = NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    return ValidationManager(
+        cluster, provider, KEYS, prober=prober,
+        timeout_seconds=timeout_seconds,
+    )
+
+
+def _node_group(cluster, name="n0"):
+    node = make_node(name)
+    cluster.create_node(node)
+    return UpgradeGroup(id=name, members=[NodeUpgradeState(node=node)])
+
+
+def test_async_probe_runs_off_the_reconcile_thread():
+    cluster = FakeCluster()
+    prober = GatedProber()
+    vm = _vm(cluster, prober)
+    group = _node_group(cluster)
+
+    t0 = time.monotonic()
+    assert vm.validate(group) is False  # scheduled, not consumed
+    # The reconcile thread did NOT wait for the blocked probe.
+    assert time.monotonic() - t0 < 5.0
+    assert prober.release.is_set() is False
+    prober.release.set()
+    assert vm.wait_idle(10.0)
+    assert vm.validate(group) is True
+    assert prober.calls == 1
+    assert vm.validation_wall_s["n0"] > 0.0
+
+
+def test_async_unhealthy_verdict_consumed_once_then_reprobed():
+    cluster = FakeCluster()
+    prober = GatedProber(healthy=False)
+    prober.release.set()
+    vm = _vm(cluster, prober)
+    group = _node_group(cluster)
+
+    assert vm.validate(group) is False  # schedules probe 1
+    assert vm.wait_idle(10.0)
+    assert vm.validate(group) is False  # consumes rejection
+    assert vm.last_rejection[group.id] == "gated verdict"
+    assert vm.validate(group) is False  # schedules probe 2 (fresh)
+    assert vm.wait_idle(10.0)
+    assert prober.calls == 2
+
+
+def test_async_stale_verdict_discarded_after_timeout():
+    """A verdict from a probe scheduled BEFORE a gate timeout must not
+    pass a later re-entry of the gate (epoch guard)."""
+    cluster = FakeCluster()
+    prober = GatedProber(healthy=True)
+    vm = _vm(cluster, prober, timeout_seconds=1)
+    # Expired validation clock ON the group's node object (the timeout
+    # clock reads member annotations): the first validate() pass times
+    # out the gate while the probe is still blocked on the worker.
+    node = make_node(
+        "n0",
+        annotations={
+            KEYS.validation_start_time_annotation: str(int(time.time()) - 100)
+        },
+    )
+    cluster.create_node(node)
+    group = UpgradeGroup(id="n0", members=[NodeUpgradeState(node=node)])
+    assert vm.validate(group) is False
+    assert (
+        cluster.get_node("n0", cached=False)
+        .labels.get(KEYS.state_label)
+        == UpgradeState.FAILED.value
+    )
+    # Now the stale probe completes healthy — its verdict must be dropped.
+    prober.release.set()
+    assert vm.wait_idle(10.0)
+    assert vm._probe_verdicts == {}
+    # A later gate re-entry schedules a FRESH probe instead of consuming
+    # the stale pass.
+    fresh = cluster.get_node("n0", cached=False)
+    regroup = UpgradeGroup(id="n0", members=[NodeUpgradeState(node=fresh)])
+    assert vm.validate(regroup) is False
+    assert vm.wait_idle(10.0)
+    assert prober.calls == 2
+    assert vm.validate(regroup) is True
+
+
+def test_async_spawn_failure_unclaims_inflight():
+    cluster = FakeCluster()
+    prober = GatedProber()
+    prober.release.set()
+    vm = _vm(cluster, prober)
+    group = _node_group(cluster)
+
+    real_spawn = vm._tracker.spawn
+
+    def boom(fn, name=None):
+        raise RuntimeError("thread limit")
+
+    vm._tracker.spawn = boom
+    assert vm.validate(group) is False
+    assert vm._probe_inflight == set()  # claim not stranded
+    vm._tracker.spawn = real_spawn
+    assert vm.validate(group) is False  # retries cleanly
+    assert vm.wait_idle(10.0)
+    assert vm.validate(group) is True
+
+
+# --- pipelined validation vs the sharded budget ledger ----------------------
+
+
+def _pipeline_policy(pipeline=True, max_unavailable=1):
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString(max_unavailable),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        pipeline_validation=pipeline,
+        health_gate=SliceHealthGateSpec(timeout_second=30),
+    )
+
+
+def test_sync_from_state_skips_validating_schedulable_under_pipeline():
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v2", revision=2)
+    # pool-v: validating, every host back in service (pipelined gate).
+    for n in fx.tpu_slice(
+        "pool-v", hosts=2, state=UpgradeState.VALIDATION_REQUIRED
+    ):
+        fx.driver_pod(n, ds, hash_suffix="v2")
+    # pool-c: validating but still cordoned — must stay charged.
+    for n in fx.tpu_slice(
+        "pool-c",
+        hosts=2,
+        state=UpgradeState.VALIDATION_REQUIRED,
+        unschedulable=True,
+    ):
+        fx.driver_pod(n, ds, hash_suffix="v2")
+    mgr = ClusterUpgradeStateManager(cluster, keys=KEYS)
+    policy = _pipeline_policy(pipeline=True, max_unavailable=3)
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+
+    led = BudgetLedger()
+    led.sync_from_state(mgr, state, policy)
+    # The resync re-baseline must not silently undo the pipelined
+    # release: schedulable validating groups hold no budget.
+    assert not led.holds("pool-v")
+    assert led.holds("pool-c")
+
+    # Without the pipeline knob both validating groups are charged.
+    led_serial = BudgetLedger()
+    led_serial.sync_from_state(
+        mgr, state, _pipeline_policy(pipeline=False, max_unavailable=3)
+    )
+    assert led_serial.holds("pool-v")
+    assert led_serial.holds("pool-c")
+
+
+def _restarted_slice(gate_timeout=30):
+    """A 2-host cordoned slice in POD_RESTART_REQUIRED with every driver
+    pod already at the new revision — next pass enters validation."""
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v2", revision=2)
+    nodes = fx.tpu_slice(
+        "pool-a",
+        hosts=2,
+        state=UpgradeState.POD_RESTART_REQUIRED,
+        unschedulable=True,
+    )
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v2")
+    prober = GatedProber()
+    prober.release.set()  # verdicts return immediately when probed
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    ).with_validation_enabled(prober)
+    # The battery is real thread work; keep rollback drains quick.
+    mgr.validation_manager.rollback_drain_timeout_s = 0.3
+    mgr.validation_manager.rollback_poll_interval_s = 0.02
+    led = BudgetLedger()
+    led.configure(
+        total_units=4, max_parallel=0, max_unavailable=1, unit="slice"
+    )
+    assert led.try_claim("pool-a", 1)  # the claim admission made
+    mgr.budget_ledger = led
+    return cluster, mgr, led, nodes
+
+
+def _tick(cluster, mgr, policy):
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+    mgr.apply_state(state, policy)
+    assert mgr.wait_for_async_work(30.0)
+
+
+def test_pipelined_ledger_released_at_validation_entry():
+    cluster, mgr, led, nodes = _restarted_slice()
+    _tick(cluster, mgr, _pipeline_policy(pipeline=True))
+    for n in nodes:
+        assert (
+            state_of(cluster, KEYS, n.name)
+            == UpgradeState.VALIDATION_REQUIRED.value
+        )
+        assert not cluster.get_node(n.name, cached=False).spec.unschedulable
+    # The slot is free: the next slice can claim while pool-a validates.
+    assert not led.holds("pool-a")
+    assert led.try_claim("pool-b", 1)
+
+
+def test_serial_ledger_keeps_claim_through_validation():
+    cluster, mgr, led, nodes = _restarted_slice()
+    _tick(cluster, mgr, _pipeline_policy(pipeline=False))
+    for n in nodes:
+        assert (
+            state_of(cluster, KEYS, n.name)
+            == UpgradeState.VALIDATION_REQUIRED.value
+        )
+    assert led.holds("pool-a")
+    assert not led.try_claim("pool-b", 1)
+
+
+def test_pipelined_ledger_recharged_on_timeout_recordon():
+    cluster, mgr, led, nodes = _restarted_slice()
+    policy = _pipeline_policy(pipeline=True)
+    _tick(cluster, mgr, policy)
+    assert not led.holds("pool-a")
+    # Expire the gate clock: the next pass times out, re-cordons, and
+    # must take the budget back — the unavailability is real again.
+    old = str(int(time.time()) - 100)
+    for n in nodes:
+        cluster.patch_node_annotations(
+            n.name, {KEYS.validation_start_time_annotation: old}
+        )
+    _tick(cluster, mgr, policy)
+    for n in nodes:
+        assert state_of(cluster, KEYS, n.name) == UpgradeState.FAILED.value
+        assert cluster.get_node(n.name, cached=False).spec.unschedulable
+    assert led.holds("pool-a")
+    assert led.unavailable_used() == 1
+    assert not led.try_claim("pool-b", 1)  # budget honest again

@@ -183,6 +183,64 @@ def test_blocked_rollback_is_evented_tracked_and_retried():
             UpgradeState.FAILED.value}) in gauges.removed
 
 
+def test_spawn_failure_does_not_strand_the_active_claim():
+    """If the rollback worker thread fails to SPAWN, the group's
+    ``_rollback_active`` claim must be released — a stranded claim would
+    silently skip every future retry while workload pods sit on
+    gate-rejected hardware."""
+    import pytest
+
+    from k8s_operator_libs_tpu.upgrade.types import (
+        NodeUpgradeState,
+        UpgradeGroup,
+    )
+
+    c, fx, mgr, policy, nodes, wl, recorder = _timed_out_validating_slice()
+    vm = mgr.validation_manager
+    group = UpgradeGroup(
+        id="pool-a", members=[NodeUpgradeState(node=n) for n in nodes]
+    )
+    real_spawn = vm._tracker.spawn
+
+    def boom(fn, name=None):
+        raise RuntimeError("thread limit")
+
+    vm._tracker.spawn = boom
+    with pytest.raises(RuntimeError):
+        vm._schedule_rollback_eviction(group)
+    assert vm._rollback_active == set()
+
+    # The next attempt is NOT shadow-banned: with spawn healthy again the
+    # eviction runs (and records the PDB block for the retry loop).
+    vm._tracker.spawn = real_spawn
+    vm._schedule_rollback_eviction(group)
+    assert vm.wait_idle(30.0)
+    assert "pool-a" in vm.pending_rollback
+
+
+def test_completion_events_only_for_nodes_that_failed():
+    """The closing Normal event fires only on nodes whose eviction
+    actually failed earlier — a node that drained clean on the first
+    attempt never warned, so a completion there would be an unpaired
+    noise event."""
+    c, fx, mgr, policy, nodes, wl, recorder = _timed_out_validating_slice()
+    _tick(mgr, policy)
+    # Only nodes[0] hosts the PDB-blocked workload pod.
+    assert mgr.validation_manager._rollback_failed_nodes == {
+        "pool-a": [nodes[0].name]
+    }
+    c.set_eviction_blocked(wl.namespace, wl.name, blocked=False)
+    _tick(mgr, policy)
+    completions = [
+        e
+        for e in recorder.events
+        if e.event_type == "Normal"
+        and "Rollback eviction completed" in e.message
+    ]
+    assert {e.object_name for e in completions} == {nodes[0].name}
+    assert not any(e.object_name == nodes[1].name for e in completions)
+
+
 def test_recovery_moots_pending_rollback():
     """A group that recovers (gate passes) while its rollback eviction
     is still blocked stops being tracked: the hardware was re-validated,

@@ -1,8 +1,8 @@
-"""Hot-path regression guard for the informer-backed cached reconcile
-and the sharded dirty-set reconcile.
+"""Hot-path regression guard for the informer-backed cached reconcile,
+the sharded dirty-set reconcile, and the fused probe battery.
 
-``make bench-guard`` runs this standalone (no accelerator, no jax
-device work — the engine + FakeCluster only), in two stages:
+``make bench-guard`` runs this standalone (no accelerator needed — the
+probe stage runs on jax's virtual CPU mesh), in three stages:
 
 1. **Cached reconcile** (256 nodes): builds the steady-state pool from
    the scale pin (tests/test_scale.py), syncs an Informer, drives full
@@ -19,9 +19,20 @@ device work — the engine + FakeCluster only), in two stages:
    ceiling, and a single watch delta must make the next tick walk
    exactly 1 pool (never the fleet).
 
+3. **Fused probe battery** (8-device CPU mesh): runs the single-dispatch
+   battery cold then warm and pins the compile-cache contract — the
+   second run of the same topology MUST be a cache hit, the warm battery
+   must finish under its per-node ceiling, and the full async validation
+   gate (stamp -> healthy verdict through ValidationManager +
+   LocalDeviceProber) must clear one slice under its wall-time ceiling.
+
 bench.py imports ``measure()`` / ``measure_sharded()`` for its
 ``cached_reconcile`` / ``sharded_reconcile`` stages so the nightly
-artifact records the same numbers this gate enforces.
+artifact records the same numbers this gate enforces; its
+``fused_battery`` artifact records the same cache-hit/warm-time
+contract from the production-size battery on the real backend
+(``measure_probe_battery()`` here re-pins it on a CPU mesh so CI needs
+no accelerator).
 """
 
 from __future__ import annotations
@@ -55,6 +66,21 @@ SHARDED_IDLE_P99_CEILING_S = 0.05
 # One dirty pool = one scoped build (16 nodes) + one scoped apply; a
 # second of wall-clock means the scoped path regressed to O(fleet).
 SHARDED_ACTIVE_TICK_CEILING_S = 1.0
+
+# Probe-battery stage: CPU-sized battery (the pins are about CACHING
+# and dispatch-count, which are size-independent — real-hardware sizes
+# would just melt a CI box).
+BATTERY_MATMUL_N = 256
+BATTERY_HBM_MIB = 4
+BATTERY_ALLREDUCE_ELEMS = 1 << 14
+# Warm fused battery per node — the tentpole number: node 2..N of a
+# topology pays a single XLA dispatch, never a recompile.  A breach
+# means the topology key churned (cache miss) or the battery grew a
+# second dispatch.
+BATTERY_WARM_CEILING_S = 1.0
+# Full async validation gate (stamp -> healthy) for one slice with a
+# warm compile cache, including worker-thread handoff latency.
+VALIDATION_WALL_CEILING_S = 10.0
 
 
 def measure(
@@ -230,6 +256,102 @@ def measure_sharded(
     }
 
 
+def measure_probe_battery() -> dict:
+    """Cold/warm fused-battery + async-gate measurement on the virtual
+    CPU mesh; returns the artifact dict (also embedded in
+    BENCH_DETAILS.json by bench.py)."""
+    import time
+
+    # Keep the unfused fallback (if the battery ever falls back here)
+    # from escalating its sustained-measurement loops on a busy CI box.
+    os.environ.setdefault("K8S_TPU_PROBE_MIN_TIME_S", "0.01")
+    from k8s_operator_libs_tpu import hostenv
+
+    hostenv.pin_current_process_to_cpu(default_host_device_count=8)
+
+    from k8s_operator_libs_tpu.health import fused
+    from k8s_operator_libs_tpu.health.probes import run_host_probe
+
+    sizes = dict(
+        matmul_n=BATTERY_MATMUL_N,
+        hbm_mib=BATTERY_HBM_MIB,
+        allreduce_elems=BATTERY_ALLREDUCE_ELEMS,
+    )
+    fused.reset_battery_cache()
+    t0 = time.monotonic()
+    cold_checks = run_host_probe(fused=True, **sizes)
+    cold_s = time.monotonic() - t0
+    # Second node of the same topology: identical key, zero compile.
+    t0 = time.monotonic()
+    warm_checks = run_host_probe(fused=True, **sizes)
+    warm_s = time.monotonic() - t0
+    stats = fused.battery_stats()
+    warm_hit = any(
+        c.metrics.get("battery_cache_hit") == 1.0 for c in warm_checks
+    )
+
+    # Async pipelined gate: wall-clock from validation stamp to healthy
+    # verdict for one slice, probed on a worker thread (warm cache).
+    from k8s_operator_libs_tpu.health.slice_prober import LocalDeviceProber
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+    from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+        NodeUpgradeStateProvider,
+    )
+    from k8s_operator_libs_tpu.upgrade.types import (
+        NodeUpgradeState,
+        UpgradeGroup,
+    )
+    from k8s_operator_libs_tpu.upgrade.validation_manager import (
+        ValidationManager,
+    )
+
+    from fixtures import make_node
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    cluster.create_node(make_node("bench-val-0"))
+    provider = NodeUpgradeStateProvider(
+        cluster, keys, poll_interval_s=0.005, poll_timeout_s=5.0
+    )
+    vm = ValidationManager(
+        cluster,
+        provider,
+        keys,
+        prober=LocalDeviceProber(fused=True, **sizes),
+        timeout_seconds=60,
+    )
+    gate_passed = False
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        fresh = cluster.get_node("bench-val-0", cached=False)
+        group = UpgradeGroup(
+            id="bench-slice", members=[NodeUpgradeState(node=fresh)]
+        )
+        if vm.validate(group):
+            gate_passed = True
+            break
+        time.sleep(0.01)
+    vm.wait_idle(10.0)
+    validation_wall_s = vm.validation_wall_s.get("bench-slice", -1.0)
+
+    return {
+        "devices": 8,
+        "cold_s": round(cold_s, 4),
+        "warm_s": round(warm_s, 4),
+        "warm_cache_hit": warm_hit,
+        "compile_cache_hits": stats["compile_cache_hits"],
+        "compile_cache_misses": stats["compile_cache_misses"],
+        "fallbacks": stats["fallbacks"],
+        "checks_ok": all(c.ok for c in cold_checks)
+        and all(c.ok for c in warm_checks),
+        "gate_passed": gate_passed,
+        "validation_wall_s": round(validation_wall_s, 4),
+        "warm_ceiling_s": BATTERY_WARM_CEILING_S,
+        "validation_wall_ceiling_s": VALIDATION_WALL_CEILING_S,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -283,6 +405,40 @@ def main() -> int:
                 f"{f}",
                 file=sys.stderr,
             )
+        return 1
+
+    battery = measure_probe_battery()
+    failures = []
+    if not battery["checks_ok"]:
+        failures.append("fused battery produced a failing check")
+    if battery["fallbacks"]:
+        failures.append(
+            f"fused battery fell back to unfused probes "
+            f"{battery['fallbacks']} time(s)"
+        )
+    if not battery["warm_cache_hit"]:
+        failures.append(
+            "second same-topology battery missed the compile cache "
+            "(topology key churned?)"
+        )
+    if battery["warm_s"] > BATTERY_WARM_CEILING_S:
+        failures.append(
+            f"warm fused battery took {battery['warm_s']}s > ceiling "
+            f"{BATTERY_WARM_CEILING_S}s (recompile or extra dispatch in "
+            "the warm path?)"
+        )
+    if not battery["gate_passed"]:
+        failures.append("async validation gate never passed")
+    elif battery["validation_wall_s"] > VALIDATION_WALL_CEILING_S:
+        failures.append(
+            f"validation gate wall-clock {battery['validation_wall_s']}s "
+            f"> ceiling {VALIDATION_WALL_CEILING_S}s per slice"
+        )
+    battery["ok"] = not failures
+    print(json.dumps(battery, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (battery): {f}", file=sys.stderr)
         return 1
     return 0
 
