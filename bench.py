@@ -1350,6 +1350,7 @@ def main() -> None:
     sys.path.insert(0, os.path.join(_ROOT, "tools"))
     from bench_guard import measure as measure_cached_reconcile  # noqa: E402
     from bench_guard import (  # noqa: E402
+        measure_elastic as measure_elastic_roll,
         measure_sharded as measure_sharded_reconcile,
     )
 
@@ -1363,6 +1364,23 @@ def main() -> None:
     sharded_reconcile = measure_sharded_reconcile()
     beat()
     log(f"sharded reconcile (4096-node dirty set): {sharded_reconcile}")
+
+    # -- elastic roll: workload-negotiated mesh reshaping --------------------
+    # (gated by `make bench-guard`)  A second, live ElasticCanaryRunner
+    # answers exclusion offers while every slice rolls: downtime_s must
+    # be exactly 0.00 (longest canary gap stays at step granularity),
+    # and the decline variant must complete on the classic drain path.
+    # Runs on THIS bench's devices (pin_cpu would repoint the process).
+    elastic_roll = measure_elastic_roll(
+        accept=True, devices=devices, pin_cpu=False
+    )
+    beat()
+    log(f"elastic roll (accept): {elastic_roll}")
+    elastic_fallback = measure_elastic_roll(
+        accept=False, devices=devices, pin_cpu=False
+    )
+    beat()
+    log(f"elastic roll (decline fallback): {elastic_fallback}")
 
     complete = seq_result["complete"]
     details = {
@@ -1413,6 +1431,10 @@ def main() -> None:
         "failure_injection": failinj,
         "cached_reconcile": cached_reconcile,
         "sharded_reconcile": sharded_reconcile,
+        "elastic_roll": {
+            "accept": elastic_roll,
+            "decline_fallback": elastic_fallback,
+        },
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1495,6 +1517,10 @@ def main() -> None:
         "sharded_active_pools_walked": sharded_reconcile[
             "active_pools_walked"
         ],
+        "elastic_downtime_s": elastic_roll["downtime_s"],
+        "elastic_max_gap_s": elastic_roll["max_gap_s"],
+        "elastic_complete": elastic_roll["converged"],
+        "elastic_fallback_complete": elastic_fallback["converged"],
         "fused_battery_warm_s": fused_battery["warm_s"],
         "fused_battery_cache_hit": fused_battery["warm_cache_hit"],
         "fused_battery_fallbacks": fused_battery["fallbacks"],

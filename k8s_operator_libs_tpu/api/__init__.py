@@ -3,6 +3,7 @@
 from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
     DrainSpec,
     DriverUpgradePolicySpec,
+    ElasticCoordinationSpec,
     EvictionEscalationSpec,
     IntOrString,
     PodDeletionSpec,

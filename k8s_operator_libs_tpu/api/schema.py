@@ -71,6 +71,8 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("EvictionEscalationSpec", "evict_timeout_second"): {"minimum": 0},
     ("EvictionEscalationSpec", "delete_timeout_second"): {"minimum": 0},
     ("SliceQuarantineSpec", "ready_dwell_second"): {"minimum": 0},
+    ("ElasticCoordinationSpec", "offer_timeout_second"): {"minimum": 0},
+    ("ElasticCoordinationSpec", "rejoin_timeout_second"): {"minimum": 0},
 }
 
 

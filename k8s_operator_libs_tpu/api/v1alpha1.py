@@ -90,6 +90,8 @@ _JSON_NAME_OVERRIDES = {
     "delete_timeout_second": "deleteTimeoutSeconds",
     "ready_dwell_second": "readyDwellSeconds",
     "pdb_grace_second": "pdbGraceSeconds",
+    "offer_timeout_second": "offerTimeoutSeconds",
+    "rejoin_timeout_second": "rejoinTimeoutSeconds",
 }
 
 
@@ -358,6 +360,42 @@ class SliceQuarantineSpec(_SpecBase):
 
 
 @dataclass
+class ElasticCoordinationSpec(_SpecBase):
+    """Workload-negotiated mesh reshaping for zero-downtime rolls (new
+    component, Tenplex-style elasticity).
+
+    With coordination enabled, an admitted slice whose nodes carry a
+    workload registration annotation is offered for exclusion instead of
+    being cordoned outright: the workload resizes its mesh away from the
+    slice (checkpoint-free, host-side snapshot + re-shard), the roll
+    proceeds with zero workload downtime, and after uncordon the slice is
+    offered back for a rejoin-resize.  Decline or timeout falls back to
+    the pre-existing drain path — coordination only adds capability,
+    never removes safety.  Disabled by default: it requires an elastic
+    workload agent (coordination.WorkloadCoordinator) in the job.
+    """
+
+    enable: bool = False
+    # Seconds the controller waits for the workload's accept/decline +
+    # resize-complete before falling back to the drain path.
+    offer_timeout_second: int = 60
+    # Seconds the controller waits after uncordon for the rejoin-resize
+    # before declaring the group done anyway (the workload can rejoin
+    # later on its own schedule; the roll must not hang on it).
+    rejoin_timeout_second: int = 300
+
+    def validate(self) -> None:
+        if self.offer_timeout_second < 0:
+            raise ValidationError(
+                "elastic.offerTimeoutSeconds must be >= 0"
+            )
+        if self.rejoin_timeout_second < 0:
+            raise ValidationError(
+                "elastic.rejoinTimeoutSeconds must be >= 0"
+            )
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -403,6 +441,9 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     slice_quarantine: Optional[SliceQuarantineSpec] = field(
         default_factory=SliceQuarantineSpec
     )
+    # Elastic roll coordination: negotiate workload mesh reshaping before
+    # cordoning a slice (None/disabled = today's drain rolls unchanged).
+    elastic: Optional[ElasticCoordinationSpec] = None
 
     def validate(self) -> None:
         super().validate()
@@ -419,6 +460,8 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.health_gate.validate()
         if self.slice_quarantine is not None:
             self.slice_quarantine.validate()
+        if self.elastic is not None:
+            self.elastic.validate()
 
 
 # Nested-type registry for from_dict (maps (class, field) -> spec type).
@@ -433,4 +476,5 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("TPUUpgradePolicySpec", "topology"): SliceTopologySpec,
     ("TPUUpgradePolicySpec", "health_gate"): SliceHealthGateSpec,
     ("TPUUpgradePolicySpec", "slice_quarantine"): SliceQuarantineSpec,
+    ("TPUUpgradePolicySpec", "elastic"): ElasticCoordinationSpec,
 }

@@ -321,6 +321,29 @@ class UpgradeMetrics:
             "probe_battery_cached_programs",
             "Distinct topology keys currently held in the compile cache",
         )
+        # Elastic roll coordination surface (absent on injected fakes
+        # and on controllers with `elastic` disabled in policy).
+        r.describe(
+            "elastic_negotiations_total",
+            "Exclusion-offer negotiations settled since controller start",
+            "outcome",
+        )
+        r.describe(
+            "elastic_resizes_total",
+            "Workload mesh resizes completed (down = slice excluded, "
+            "up = slice rejoined)",
+            "direction",
+        )
+        r.describe(
+            "elastic_resize_seconds",
+            "Offer-to-resize-complete wall-clock of the last workload "
+            "mesh resize (annotation epochs, 1s resolution)",
+        )
+        r.describe(
+            "elastic_excluded_slices",
+            "Slices currently excluded from their workload's mesh "
+            "(rolling without budget charge)",
+        )
         r.describe(
             "validation_wall_seconds",
             "Wall-clock of each slice's last passed validation gate "
@@ -365,6 +388,27 @@ class UpgradeMetrics:
             "quarantine_cycle_demotions_total",
             getattr(manager, "quarantine_cycle_demotions", 0),
         )
+        negotiations = getattr(manager, "elastic_negotiations", None)
+        if negotiations is not None:
+            for outcome, count in sorted(negotiations.items()):
+                r.set("elastic_negotiations_total", count, outcome=outcome)
+        resizes = getattr(manager, "elastic_resizes", None)
+        if resizes is not None:
+            for direction, count in sorted(resizes.items()):
+                r.set("elastic_resizes_total", count, direction=direction)
+            r.set(
+                "elastic_resize_seconds",
+                getattr(manager, "elastic_resize_seconds", 0.0),
+            )
+        excluded_check = getattr(manager, "_group_elastic_excluded", None)
+        if excluded_check is not None:
+            excluded = {
+                group.id
+                for groups in state.groups.values()
+                for group in groups
+                if excluded_check(group)
+            }
+            r.set("elastic_excluded_slices", len(excluded))
         esc_stats = getattr(manager, "escalation_stats", None)
         if esc_stats is not None and hasattr(esc_stats, "snapshot"):
             for rung, count in sorted(esc_stats.snapshot().items()):

@@ -20,7 +20,7 @@ from typing import Optional
 
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.metrics import PREFIX
-from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+from k8s_operator_libs_tpu.upgrade.consts import TRUE_STRING, UpgradeState
 from k8s_operator_libs_tpu.upgrade.node_state_provider import node_ready
 from k8s_operator_libs_tpu.upgrade.upgrade_state import (
     BuildStateError,
@@ -47,6 +47,15 @@ SHARDED_METRIC_KEYS = {
     "budget_unavailable_used": "budgetUsed",
     "budget_unavailable_cap": "budgetCap",
     "budget_parallel_used": "budgetParallel",
+}
+
+
+# Controller /metrics series → status keys for the elastic-coordination
+# section (unlabeled series only; elastic_negotiations_total{outcome=...}
+# and elastic_resizes_total{direction=...} are parsed label-aware below).
+ELASTIC_METRIC_KEYS = {
+    "elastic_excluded_slices": "excludedSlices",
+    "elastic_resize_seconds": "lastResizeSeconds",
 }
 
 
@@ -143,6 +152,52 @@ def battery_health(metrics_url: str, fetch=None) -> Optional[dict]:
                 out[key] = val
     if walls:
         out["validationWallSeconds"] = walls
+    return out or None
+
+
+def elastic_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Elastic-roll coordination health from the controller's /metrics.
+
+    Returns None when the elastic family is absent (coordination never
+    engaged — disabled in policy, or no registered workloads), an
+    ``{"error": ...}`` dict when the endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    negotiations: dict[str, float] = {}
+    resizes: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "elastic_negotiations_total":
+            outcome = labels.split('outcome="', 1)
+            if len(outcome) == 2:
+                negotiations[outcome[1].split('"', 1)[0]] = val
+        elif short == "elastic_resizes_total":
+            direction = labels.split('direction="', 1)
+            if len(direction) == 2:
+                resizes[direction[1].split('"', 1)[0]] = val
+        else:
+            key = ELASTIC_METRIC_KEYS.get(short)
+            if key is not None:
+                out[key] = val
+    if negotiations:
+        out["negotiations"] = negotiations
+    if resizes:
+        out["resizes"] = resizes
     return out or None
 
 
@@ -252,6 +307,11 @@ def gather(
                     default=0,
                 ),
                 "quarantined": effective == UpgradeState.QUARANTINED.value,
+                "elasticExcluded": any(
+                    m.node.annotations.get(keys.elastic_excluded_annotation)
+                    == TRUE_STRING
+                    for m in group.members
+                ),
                 "accelerator": (
                     group.slice_info.accelerator if group.slice_info else ""
                 ),
@@ -325,6 +385,9 @@ def gather(
         battery = battery_health(metrics_url, fetch=metrics_fetch)
         if battery is not None:
             out["probeBattery"] = battery
+        elastic = elastic_health(metrics_url, fetch=metrics_fetch)
+        if elastic is not None:
+            out["elasticCoordination"] = elastic
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -361,12 +424,14 @@ def render(status: dict) -> str:
         f"quarantined {status.get('slicesQuarantined', 0)}",
         "",
         f"{'GROUP':32s} {'STATE':24s} {'HOSTS':>5s} {'UNAVAIL':>7s} "
-        f"{'TOPOLOGY':10s} DCN",
+        f"{'TOPOLOGY':10s} {'ELASTIC':8s} DCN",
     ]
     for g in status["groups"]:
+        elastic_flag = "excluded" if g.get("elasticExcluded") else ""
         lines.append(
             f"{g['group'][:32]:32s} {g['state']:24s} {g['hosts']:>5d} "
-            f"{g['unavailable']:>7d} {g['topology']:10s} {g['dcn_group']}"
+            f"{g['unavailable']:>7d} {g['topology']:10s} "
+            f"{elastic_flag:8s} {g['dcn_group']}"
         )
     esc = status.get("evictionEscalationsInFlight") or {}
     if esc:
@@ -477,6 +542,24 @@ def render(status: dict) -> str:
                         f"{gid}={s:.1f}s" for gid, s in sorted(walls.items())
                     )
                 )
+    elastic = status.get("elasticCoordination")
+    if elastic is not None:
+        lines.append("")
+        if "error" in elastic:
+            lines.append(f"elastic coordination: {elastic['error']}")
+        else:
+            neg = elastic.get("negotiations") or {}
+            res = elastic.get("resizes") or {}
+            lines.append(
+                f"elastic coordination: "
+                f"{int(elastic.get('excludedSlices', 0))} slice(s) excluded"
+                f" | negotiations accept {int(neg.get('accept', 0))} "
+                f"decline {int(neg.get('decline', 0))} "
+                f"timeout {int(neg.get('timeout', 0))}"
+                f" | resizes down {int(res.get('down', 0))} "
+                f"up {int(res.get('up', 0))} "
+                f"(last {elastic.get('lastResizeSeconds', 0.0):.1f}s)"
+            )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")

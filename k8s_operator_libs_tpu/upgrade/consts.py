@@ -25,6 +25,10 @@ class UpgradeState(str, enum.Enum):
     UNKNOWN = ""
     # Driver pod on the node is outdated; no actions performed yet.
     UPGRADE_REQUIRED = "upgrade-required"
+    # An elastic-coordination offer is posted to the slice's registered
+    # workload; the slice waits (bounded by offerTimeoutSeconds) for the
+    # workload to resize away from it before any disruptive action.
+    NEGOTIATE_REQUIRED = "negotiate-required"
     # Node must be made unschedulable before the driver upgrade.
     CORDON_REQUIRED = "cordon-required"
     # Wait (up to a timeout) for user jobs on the node to complete.
@@ -39,6 +43,9 @@ class UpgradeState(str, enum.Enum):
     VALIDATION_REQUIRED = "validation-required"
     # Driver pod is up-to-date and Ready; node must be made schedulable.
     UNCORDON_REQUIRED = "uncordon-required"
+    # Slice uncordoned while still excluded-by-resize: a rejoin offer is
+    # posted so the workload resizes back over the slice before DONE.
+    REJOIN_RESIZE_REQUIRED = "rejoin-resize-required"
     # Upgrade finished; node schedulable and driver current.
     DONE = "upgrade-done"
     # Any failure during the upgrade lands here.
@@ -60,9 +67,12 @@ class UpgradeState(str, enum.Enum):
 # (one member stuck at uncordon-required after a crashed batch write) must
 # resolve to the straggler's state so the next pass re-drives it — ranking
 # done early would strand the straggler forever.
-STATE_ORDER: dict[UpgradeState, int] = {
+STATE_ORDER: dict[UpgradeState, float] = {
     UpgradeState.UNKNOWN: 0,
     UpgradeState.UPGRADE_REQUIRED: 2,
+    # Between admission and cordon: a slice mid-negotiation has claimed a
+    # slot but taken no disruptive action yet.
+    UpgradeState.NEGOTIATE_REQUIRED: 2.5,
     UpgradeState.CORDON_REQUIRED: 3,
     UpgradeState.WAIT_FOR_JOBS_REQUIRED: 4,
     UpgradeState.POD_DELETION_REQUIRED: 5,
@@ -70,6 +80,9 @@ STATE_ORDER: dict[UpgradeState, int] = {
     UpgradeState.POD_RESTART_REQUIRED: 7,
     UpgradeState.VALIDATION_REQUIRED: 8,
     UpgradeState.UNCORDON_REQUIRED: 9,
+    # After uncordon, before done: hosts serve again but the workload has
+    # not yet resized back over the slice.
+    UpgradeState.REJOIN_RESIZE_REQUIRED: 9.5,
     UpgradeState.DONE: 10,
     UpgradeState.FAILED: 100,
     # Dominates even FAILED (UpgradeGroup.effective_state checks it first):
@@ -99,6 +112,13 @@ def parse_state(value: str) -> UpgradeState:
 # upgraded), and the stuck detector — which walks exactly these states —
 # must treat quarantine as a *reason* for a stall, never a stuck state.
 IN_PROGRESS_STATES: tuple[UpgradeState, ...] = (
+    # NEGOTIATE_REQUIRED holds the parallel slot / budget claim made at
+    # admission (released only when the workload's resize-complete excludes
+    # the slice), so it counts as in progress and is quarantinable.
+    # REJOIN_RESIZE_REQUIRED is deliberately NOT here: its hosts are
+    # uncordoned and serving, it holds no budget, and a member fault there
+    # is handled by the rejoin-timeout path, not quarantine.
+    UpgradeState.NEGOTIATE_REQUIRED,
     UpgradeState.CORDON_REQUIRED,
     UpgradeState.WAIT_FOR_JOBS_REQUIRED,
     UpgradeState.POD_DELETION_REQUIRED,
@@ -132,6 +152,11 @@ STATE_TRANSITIONS: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
      "new driver revision detected / upgrade requested"),
     (_S.UPGRADE_REQUIRED, _S.CORDON_REQUIRED,
      "slot available (or already cordoned); slice complete; DCN ring free"),
+    (_S.UPGRADE_REQUIRED, _S.NEGOTIATE_REQUIRED,
+     "slot claimed; elastic coordination enabled and workload registered"),
+    (_S.NEGOTIATE_REQUIRED, _S.CORDON_REQUIRED,
+     "offer accepted + resize complete (slice excluded, budget released) "
+     "— or declined / offer timeout (drain fallback, charge kept)"),
     (_S.CORDON_REQUIRED, _S.WAIT_FOR_JOBS_REQUIRED, "slice cordoned"),
     (_S.WAIT_FOR_JOBS_REQUIRED, _S.POD_DELETION_REQUIRED,
      "jobs finished or wait timeout (pod deletion enabled)"),
@@ -162,6 +187,11 @@ STATE_TRANSITIONS: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
     (_S.VALIDATION_REQUIRED, _S.FAILED,
      "validation timeout (pipelined mode re-cordons + evicts)"),
     (_S.UNCORDON_REQUIRED, _S.DONE, "slice uncordoned"),
+    (_S.UNCORDON_REQUIRED, _S.REJOIN_RESIZE_REQUIRED,
+     "slice uncordoned while excluded-by-resize (rejoin offer posted)"),
+    (_S.REJOIN_RESIZE_REQUIRED, _S.DONE,
+     "workload rejoin-resize complete (or rejoin timeout — exclusion "
+     "markers cleared either way)"),
     (_S.FAILED, _S.UNCORDON_REQUIRED,
      "auto-recovery: pods back in sync AND health gate passes"),
     (_S.FAILED, _S.DONE,
@@ -221,6 +251,50 @@ UPGRADE_QUARANTINE_READY_SINCE_ANNOTATION_KEY_FMT = (
 UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT = (
     "{domain}/{driver}-driver-upgrade-quarantine-cycle-count"
 )
+
+# --- elastic roll coordination ---------------------------------------------
+# The annotation-mediated negotiation protocol between the controller and
+# an elastic workload (coordination.WorkloadCoordinator).  The node
+# annotations ARE the wire: both sides are crash-safe because every message
+# is an idempotent stamp.
+# - elastic-workload: stamped by the workload agent at registration; its
+#   presence is what routes an admitted slice to negotiate-required.
+# - elastic-offer: epoch seconds when the controller posted the exclusion
+#   offer.  Stamped only-if-absent (group_clock_start), so a restarted or
+#   failed-over controller resumes the same offer clock — never
+#   double-offers — and the offer timeout survives crashes.
+# - elastic-response: "accept" | "decline", written by the workload.
+# - elastic-resize-complete: epoch seconds when the workload finished
+#   resizing away from the slice (written by the workload after accept).
+# - elastic-excluded: "true" while the slice is excluded from the
+#   workload's mesh; an excluded slice holds no maxUnavailable budget
+#   (mirroring quarantine) and must pass through rejoin-resize before DONE.
+# - elastic-rejoin-offer / elastic-rejoin-complete: the same clock pair for
+#   the resize-back-up leg after uncordon.
+UPGRADE_ELASTIC_WORKLOAD_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-workload"
+)
+UPGRADE_ELASTIC_OFFER_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-offer"
+)
+UPGRADE_ELASTIC_RESPONSE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-response"
+)
+UPGRADE_ELASTIC_RESIZE_COMPLETE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-resize-complete"
+)
+UPGRADE_ELASTIC_EXCLUDED_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-excluded"
+)
+UPGRADE_ELASTIC_REJOIN_OFFER_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-rejoin-offer"
+)
+UPGRADE_ELASTIC_REJOIN_COMPLETE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-elastic-rejoin-complete"
+)
+# Values the workload writes into the elastic-response annotation.
+ELASTIC_RESPONSE_ACCEPT = "accept"
+ELASTIC_RESPONSE_DECLINE = "decline"
 
 # --- durable in-flight progress clocks -------------------------------------
 # Every escalation/backoff decision the controller makes mid-roll is

@@ -440,6 +440,12 @@ class BudgetLedger:
                     # the pipeline every full resync (mirrors the local
                     # slot math's _in_progress_units(pipeline=True)).
                     continue
+                if manager._group_elastic_excluded(group):
+                    # Excluded-by-resize: the workload reshaped around the
+                    # slice, so it holds no budget (mirrors quarantine);
+                    # re-charging at resync would undo the exclusion's
+                    # release.
+                    continue
                 charges[group.id] = 1 if unit == "slice" else group.size()
                 if (
                     dcn_anti_affinity
@@ -452,6 +458,8 @@ class BudgetLedger:
             eff = group.effective_state(manager.keys.state_label)
             if eff in IN_PROGRESS_STATES or eff == UpgradeState.QUARANTINED:
                 continue  # claimed above, or quarantine holds no budget
+            if manager._group_elastic_excluded(group):
+                continue  # excluded-by-resize holds no budget either
             if unit == "slice":
                 if manager._group_unavailable(group):
                     external += 1

@@ -41,6 +41,8 @@ from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
+    ELASTIC_RESPONSE_ACCEPT,
+    ELASTIC_RESPONSE_DECLINE,
     IN_PROGRESS_STATES,
     QUARANTINABLE_STATES,
     TRUE_STRING,
@@ -188,6 +190,17 @@ class ClusterUpgradeStateManager:
         self.quarantine_cycle_demotions = 0
         self.quarantine_reasons: dict[str, str] = {}
         self.stuck_detector.add_reason_source(self.quarantine_reasons.get)
+        # Elastic roll coordination lifetime counters (metrics.py reads
+        # them off the manager the same way as quarantines_total).
+        self.elastic_negotiations: dict[str, int] = {
+            "accept": 0,
+            "decline": 0,
+            "timeout": 0,
+        }
+        self.elastic_resizes: dict[str, int] = {"down": 0, "up": 0}
+        # Last observed workload resize duration (offer -> resize-complete
+        # epoch delta), either direction.
+        self.elastic_resize_seconds = 0.0
         # One shared per-rung eviction-escalation counter across every
         # DrainHelper owner (drains, workload-pod deletion, rollback
         # evictions), so a single metrics read covers all drain paths.
@@ -412,6 +425,9 @@ class ClusterUpgradeStateManager:
         for st in tuple(IN_PROGRESS_STATES) + (
             UpgradeState.FAILED,
             UpgradeState.QUARANTINED,
+            # Serving hosts, but the rejoin-resize completion is still a
+            # controller action that must be term-fenced.
+            UpgradeState.REJOIN_RESIZE_REQUIRED,
         ):
             for group in state.groups_in(st):
                 summary["groups"] += 1
@@ -732,6 +748,10 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_required_groups(
             current_state, upgrades_available, unit, policy
         )
+        # Elastic negotiation sits between admission and cordon: absorbed
+        # resizes (and decline/timeout fallbacks) re-bucket into
+        # cordon-required and proceed in this same pass.
+        self.process_negotiation_groups(current_state, policy)
         self.process_cordon_required_groups(current_state)
         self.process_wait_for_jobs_required_groups(
             current_state, policy.wait_for_completion
@@ -747,6 +767,7 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_failed_groups(current_state, validation_active)
         self.process_validation_required_groups(current_state, validation_active)
         self.process_uncordon_required_groups(current_state)
+        self.process_rejoin_resize_groups(current_state, policy)
         # Re-attempt rollback evictions that previously failed (PDB,
         # API fault) for groups still FAILED — idempotent, so pods on
         # gate-rejected hardware are evicted as soon as the blocker
@@ -932,15 +953,35 @@ class ClusterUpgradeStateManager:
                     continue
             else:
                 upgrades_available -= cost
-            self.provider.change_nodes_upgrade_state(
-                group.nodes, UpgradeState.CORDON_REQUIRED
-            )
+            # Elastic coordination: a registered workload is offered the
+            # slice BEFORE any disruptive action.  The slot claim above is
+            # kept through the negotiation — decline/timeout falls back to
+            # cordon with exactly the pre-negotiation charge, and an
+            # accepted resize releases it when the exclusion is absorbed.
+            espec = self._elastic_spec(policy)
+            target = UpgradeState.CORDON_REQUIRED
+            if espec is not None and espec.enable:
+                if self._group_elastic_excluded(group):
+                    # Already excluded (quarantine-shrink): nothing to
+                    # negotiate, and an excluded slice holds no budget.
+                    if ledger is not None:
+                        ledger.release(group.id)
+                elif (
+                    not already_cordoned
+                    and self._group_elastic_registered(group)
+                ):
+                    target = UpgradeState.NEGOTIATE_REQUIRED
+            self.provider.change_nodes_upgrade_state(group.nodes, target)
             if (
                 group.slice_info is not None
                 and group.slice_info.dcn_group is not None
             ):
                 busy_dcn.add(group.slice_info.dcn_group)
-            logger.info("group %s waiting for cordon", group.id)
+            if target is UpgradeState.NEGOTIATE_REQUIRED:
+                self._move_group_bucket(state, group, target)
+                logger.info("group %s negotiating elastic resize", group.id)
+            else:
+                logger.info("group %s waiting for cordon", group.id)
 
     def process_cordon_required_groups(self, state: ClusterUpgradeState) -> None:
         """Cordon all hosts, then advance (upgrade_state.go:635-654)."""
@@ -1238,7 +1279,7 @@ class ClusterUpgradeStateManager:
         """Uncordon and finish (upgrade_state.go:915-934).  Hosts that were
         unschedulable before the upgrade stay cordoned
         (upgrade_state.go:1003-1028)."""
-        for group in state.groups_in(UpgradeState.UNCORDON_REQUIRED):
+        for group in list(state.groups_in(UpgradeState.UNCORDON_REQUIRED)):
             keep_cordoned_key = self.keys.initial_state_annotation
             to_uncordon = [
                 m.node
@@ -1251,9 +1292,15 @@ class ClusterUpgradeStateManager:
                 if keep_cordoned_key in m.node.annotations
             ]
             self.cordon_manager.uncordon_nodes(to_uncordon)
-            self.provider.change_nodes_upgrade_state(
-                group.nodes, UpgradeState.DONE
+            # An excluded-by-resize slice is not done yet: the workload
+            # must resize back over it first, so it routes through
+            # rejoin-resize (the rejoin offer is posted there).
+            next_state = (
+                UpgradeState.REJOIN_RESIZE_REQUIRED
+                if self._group_elastic_excluded(group)
+                else UpgradeState.DONE
             )
+            self.provider.change_nodes_upgrade_state(group.nodes, next_state)
             if annotated:
                 self.provider.change_nodes_upgrade_annotation(
                     annotated, keep_cordoned_key, "null"
@@ -1262,6 +1309,297 @@ class ClusterUpgradeStateManager:
                 # Hosts are schedulable again: free the fleet-wide
                 # unavailability charge and parallel slot.
                 self.budget_ledger.release(group.id)
+            if next_state is UpgradeState.REJOIN_RESIZE_REQUIRED:
+                self._move_group_bucket(state, group, next_state)
+                logger.info(
+                    "group %s uncordoned; awaiting rejoin-resize", group.id
+                )
+
+    # -- elastic roll coordination (workload-negotiated mesh reshaping) ------
+
+    @staticmethod
+    def _elastic_spec(policy):
+        if isinstance(policy, TPUUpgradePolicySpec):
+            return policy.elastic
+        return None
+
+    def _group_elastic_registered(self, group: UpgradeGroup) -> bool:
+        """An elastic workload has registered on this slice's nodes."""
+        key = self.keys.elastic_workload_annotation
+        return any(m.node.annotations.get(key) for m in group.members)
+
+    def _group_elastic_excluded(self, group: UpgradeGroup) -> bool:
+        """The workload has resized away from this slice: it holds no
+        maxUnavailable budget (mirroring quarantine) and must pass
+        through rejoin-resize before DONE."""
+        key = self.keys.elastic_excluded_annotation
+        return any(
+            m.node.annotations.get(key) == TRUE_STRING
+            for m in group.members
+        )
+
+    def _group_annotation_value(self, group: UpgradeGroup, key: str) -> str:
+        for member in group.members:
+            value = member.node.annotations.get(key, "")
+            if value:
+                return value
+        return ""
+
+    def _clear_elastic_negotiation(self, group: UpgradeGroup) -> None:
+        """Retire the offer/response/resize-complete trio (guarded per
+        key, so the common path writes nothing).  The exclusion marker is
+        NOT cleared here — it must survive until rejoin-resize."""
+        for key in (
+            self.keys.elastic_offer_annotation,
+            self.keys.elastic_response_annotation,
+            self.keys.elastic_resize_complete_annotation,
+        ):
+            carriers = [
+                m.node for m in group.members if key in m.node.annotations
+            ]
+            if carriers:
+                self.provider.change_nodes_upgrade_annotation(
+                    carriers, key, "null"
+                )
+
+    def _absorb_negotiation_response(
+        self, group: UpgradeGroup, offer_start: Optional[int]
+    ) -> bool:
+        """Absorb an accepted + completed down-resize: stamp the exclusion
+        marker, release the budget claim, count it.  Shared between the
+        negotiation processor and the quarantine scan (quarantine-shrink).
+        Returns True when the exclusion was absorbed."""
+        response = self._group_annotation_value(
+            group, self.keys.elastic_response_annotation
+        )
+        if response != ELASTIC_RESPONSE_ACCEPT:
+            return False
+        complete_epoch = parse_epoch(
+            self._group_annotation_value(
+                group, self.keys.elastic_resize_complete_annotation
+            )
+        )
+        if complete_epoch is None:
+            return False
+        if self.term_fence is not None and not self.term_fence(group.nodes):
+            # A deposed leader must not complete a resize: the successor
+            # owns the exclusion/budget bookkeeping.
+            logger.warning(
+                "term fence: not absorbing resize for group %s", group.id
+            )
+            return False
+        self.provider.change_nodes_upgrade_annotation(
+            group.nodes, self.keys.elastic_excluded_annotation, TRUE_STRING
+        )
+        self._clear_elastic_negotiation(group)
+        self.elastic_negotiations["accept"] += 1
+        self.elastic_resizes["down"] += 1
+        if offer_start is not None:
+            self.elastic_resize_seconds = float(
+                max(0, complete_epoch - offer_start)
+            )
+        for node in group.nodes:
+            log_event(
+                self.event_recorder,
+                node.name,
+                EVENT_TYPE_NORMAL,
+                "ElasticResizeComplete",
+                "Workload resized away from the slice; excluded from the "
+                "mesh (holds no unavailability budget) until rejoin-resize",
+            )
+        if self.budget_ledger is not None:
+            # The workload keeps stepping without this slice: it is not
+            # "unavailable" in the maxUnavailable sense, so the admission
+            # claim is freed for the rest of the fleet.
+            self.budget_ledger.release(group.id)
+        return True
+
+    def process_negotiation_groups(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """Drive negotiate-required groups: post the exclusion offer
+        (stamp-if-absent — a restarted controller resumes the same offer
+        clock, never double-offers), then absorb the workload's response.
+
+        Accept + resize-complete: exclusion absorbed, budget released,
+        on to cordon.  Decline or offer timeout: the elastic markers are
+        retired and the group falls back to cordon-required with its
+        admission-time budget claim intact — the exact pre-coordination
+        drain path."""
+        groups = list(state.groups_in(UpgradeState.NEGOTIATE_REQUIRED))
+        if not groups:
+            return
+        spec = self._elastic_spec(policy)
+        timeout_s = int(spec.offer_timeout_second) if spec is not None else 0
+        offer_key = self.keys.elastic_offer_annotation
+        now = int(time.time())
+        for group in groups:
+            if self._group_elastic_excluded(group):
+                # Already excluded (the resize was absorbed while
+                # quarantined): nothing left to negotiate.
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.CORDON_REQUIRED
+                )
+                self._move_group_bucket(
+                    state, group, UpgradeState.CORDON_REQUIRED
+                )
+                if self.budget_ledger is not None:
+                    self.budget_ledger.release(group.id)
+                continue
+            start = group_clock_start(self.provider, group, offer_key, now)
+            if start is None:
+                # Offer freshly posted this pass; the workload answers on
+                # a later one.
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_NORMAL,
+                        "ElasticOfferPosted",
+                        "Exclusion offer posted to the registered elastic "
+                        f"workload (timeout {timeout_s}s, then drain "
+                        "fallback)",
+                    )
+                continue
+            if self._absorb_negotiation_response(group, start):
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.CORDON_REQUIRED
+                )
+                self._move_group_bucket(
+                    state, group, UpgradeState.CORDON_REQUIRED
+                )
+                logger.info(
+                    "group %s excluded by resize; proceeding to cordon",
+                    group.id,
+                )
+                continue
+            response = self._group_annotation_value(
+                group, self.keys.elastic_response_annotation
+            )
+            declined = response == ELASTIC_RESPONSE_DECLINE
+            timed_out = not declined and now - start >= timeout_s
+            if not declined and not timed_out:
+                continue  # offer open; workload still deciding/resizing
+            outcome = "decline" if declined else "timeout"
+            self.elastic_negotiations[outcome] += 1
+            for node in group.nodes:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    "ElasticDeclined" if declined else "ElasticOfferTimeout",
+                    (
+                        "Workload declined the exclusion offer"
+                        if declined
+                        else f"Exclusion offer unanswered for {timeout_s}s"
+                    )
+                    + "; falling back to the drain path",
+                )
+            # Retire the negotiation markers BEFORE the state flip so the
+            # fallback slice is annotation-identical to a pre-coordination
+            # roll (same downstream events, same budget charge).
+            self._clear_elastic_negotiation(group)
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.CORDON_REQUIRED
+            )
+            self._move_group_bucket(state, group, UpgradeState.CORDON_REQUIRED)
+            logger.info(
+                "group %s elastic %s; falling back to drain roll",
+                group.id,
+                outcome,
+            )
+
+    def process_rejoin_resize_groups(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """Drive rejoin-resize-required groups: post the rejoin offer
+        (stamp-if-absent, same crash-safe clock as the exclusion offer)
+        and finish to DONE once the workload resized back over the slice
+        — or on rejoin timeout (the workload may rejoin later on its own
+        schedule; the roll must not hang on it)."""
+        groups = list(state.groups_in(UpgradeState.REJOIN_RESIZE_REQUIRED))
+        if not groups:
+            return
+        spec = self._elastic_spec(policy)
+        timeout_s = (
+            int(spec.rejoin_timeout_second) if spec is not None else 0
+        )
+        offer_key = self.keys.elastic_rejoin_offer_annotation
+        complete_key = self.keys.elastic_rejoin_complete_annotation
+        now = int(time.time())
+        for group in groups:
+            start = group_clock_start(self.provider, group, offer_key, now)
+            if start is None:
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_NORMAL,
+                        "ElasticRejoinOffered",
+                        "Slice upgraded and uncordoned; rejoin-resize "
+                        "offered to the workload",
+                    )
+                continue
+            complete_epoch = parse_epoch(
+                self._group_annotation_value(group, complete_key)
+            )
+            timed_out = complete_epoch is None and now - start >= timeout_s
+            if complete_epoch is None and not timed_out:
+                continue  # workload still resizing back up
+            if (
+                self.term_fence is not None
+                and not self.term_fence(group.nodes)
+            ):
+                logger.warning(
+                    "term fence: not completing rejoin for group %s",
+                    group.id,
+                )
+                continue
+            if complete_epoch is not None:
+                self.elastic_resizes["up"] += 1
+                self.elastic_resize_seconds = float(
+                    max(0, complete_epoch - start)
+                )
+            for node in group.nodes:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_NORMAL
+                    if complete_epoch is not None
+                    else EVENT_TYPE_WARNING,
+                    "ElasticRejoinComplete"
+                    if complete_epoch is not None
+                    else "ElasticRejoinTimeout",
+                    "Workload resized back over the slice"
+                    if complete_epoch is not None
+                    else f"Rejoin-resize unanswered for {timeout_s}s; "
+                    "completing the roll without it",
+                )
+            # Retire every elastic marker including the exclusion: the
+            # slice is DONE and back in the budget-accounting population.
+            for key in (
+                self.keys.elastic_excluded_annotation,
+                offer_key,
+                complete_key,
+            ):
+                carriers = [
+                    m.node
+                    for m in group.members
+                    if key in m.node.annotations
+                ]
+                if carriers:
+                    self.provider.change_nodes_upgrade_annotation(
+                        carriers, key, "null"
+                    )
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.DONE
+            )
+            self._move_group_bucket(state, group, UpgradeState.DONE)
+            logger.info("group %s rejoin-resize finished -> done", group.id)
 
     # -- slice quarantine (data-plane fault tolerance) -----------------------
 
@@ -1410,12 +1748,53 @@ class ClusterUpgradeStateManager:
                     self._move_group_bucket(
                         state, group, UpgradeState.QUARANTINED
                     )
+                    # Quarantine-shrink: offer the parked slice for
+                    # exclusion so the registered workload shrinks its
+                    # mesh around the dead hardware instead of pausing.
+                    # Stamp-if-absent — a park from negotiate-required
+                    # keeps its open offer clock.
+                    espec = self._elastic_spec(policy)
+                    if (
+                        espec is not None
+                        and espec.enable
+                        and self._group_elastic_registered(group)
+                        and not self._group_elastic_excluded(group)
+                    ):
+                        posted = group_clock_start(
+                            self.provider,
+                            group,
+                            self.keys.elastic_offer_annotation,
+                            int(time.time()),
+                        )
+                        if posted is None:
+                            for node in group.nodes:
+                                log_event(
+                                    self.event_recorder,
+                                    node.name,
+                                    EVENT_TYPE_NORMAL,
+                                    "ElasticOfferPosted",
+                                    "Exclusion offer posted for the "
+                                    "quarantined slice (mesh shrink "
+                                    "instead of a parked job)",
+                                )
 
         # Rejoin scan (runs even when the feature was just disabled, so
         # already-parked groups are not wedged forever — dwell still
         # applies from the last configured spec).
         now = int(time.time())
         for group in list(state.groups_in(UpgradeState.QUARANTINED)):
+            # Absorb a quarantine-shrink resize as soon as the workload
+            # reports it — while the hardware is still dead.  The
+            # exclusion marker then carries through the rest of the roll
+            # once the slice rejoins.
+            self._absorb_negotiation_response(
+                group,
+                parse_epoch(
+                    self._group_annotation_value(
+                        group, self.keys.elastic_offer_annotation
+                    )
+                ),
+            )
             # Cycle cap: a slice that flapped across max_cycles dwell
             # windows is hardware that keeps lying about being back —
             # demote to upgrade-failed (documented QUARANTINED->FAILED
@@ -1481,11 +1860,15 @@ class ClusterUpgradeStateManager:
                 continue  # dwell clock freshly stamped this pass
             if now - start < dwell_s:
                 continue  # hysteresis: not quiet long enough yet
-            if not self._rejoin_budget_free(state, policy, group):
+            if not self._group_elastic_excluded(
+                group
+            ) and not self._rejoin_budget_free(state, policy, group):
                 # The roll spent the freed budget on other slices while
                 # this one was parked; rejoining now would exceed
-                # maxUnavailable.  Stay parked (dwell stamp kept) until
-                # a slot frees up.
+                # maxUnavailable.  (An excluded-by-resize slice bypasses
+                # the check: the workload already reshaped around it, so
+                # it holds no budget.)  Stay parked (dwell stamp kept)
+                # until a slot frees up.
                 self.quarantine_reasons[group.id] = (
                     "quarantined: healthy, awaiting unavailability budget"
                 )
@@ -1615,6 +1998,13 @@ class ClusterUpgradeStateManager:
             self.keys.rollback_last_attempt_annotation,
             self.keys.recovery_probe_since_annotation,
             self.keys.adopted_by_annotation,
+            # Stale negotiation residue (e.g. a resize-complete stamped
+            # after the offer already timed out into the drain fallback).
+            # The exclusion + rejoin markers are NOT cleared — they must
+            # survive until rejoin-resize finishes.
+            self.keys.elastic_offer_annotation,
+            self.keys.elastic_response_annotation,
+            self.keys.elastic_resize_complete_annotation,
         ):
             carriers = [
                 m.node for m in group.members if key in m.node.annotations
@@ -1629,7 +2019,9 @@ class ClusterUpgradeStateManager:
                         "clearing %s on group %s failed: %s", key, group.id, e
                     )
         key = self.keys.initial_state_annotation
-        if all(key in m.node.annotations for m in group.members):
+        if all(
+            key in m.node.annotations for m in group.members
+        ) and not self._group_elastic_excluded(group):
             self.provider.change_nodes_upgrade_state(
                 group.nodes, UpgradeState.DONE
             )
@@ -1794,12 +2186,15 @@ class ClusterUpgradeStateManager:
         if unit == "slice":
             # Quarantined slices hold no unavailability budget (their
             # hardware loss is accounted by quarantine, not the roll).
+            # Excluded-by-resize slices likewise: the workload already
+            # reshaped around them, so the job sees no capacity loss.
             return sum(
                 1
                 for g in state.all_groups()
                 if self._group_unavailable(g)
                 and g.effective_state(self.keys.state_label)
                 != UpgradeState.QUARANTINED
+                and not self._group_elastic_excluded(g)
             )
         return self.get_current_unavailable_nodes(state)
 

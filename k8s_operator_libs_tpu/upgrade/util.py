@@ -144,6 +144,34 @@ class UpgradeKeys:
         return self._fmt(C.UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT)
 
     @property
+    def elastic_workload_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_WORKLOAD_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_offer_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_OFFER_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_response_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_RESPONSE_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_resize_complete_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_RESIZE_COMPLETE_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_excluded_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_EXCLUDED_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_rejoin_offer_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_REJOIN_OFFER_ANNOTATION_KEY_FMT)
+
+    @property
+    def elastic_rejoin_complete_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_ELASTIC_REJOIN_COMPLETE_ANNOTATION_KEY_FMT)
+
+    @property
     def eviction_rung_annotation(self) -> str:
         return self._fmt(C.UPGRADE_EVICTION_RUNG_ANNOTATION_KEY_FMT)
 

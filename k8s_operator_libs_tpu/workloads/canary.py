@@ -25,7 +25,7 @@ on real slices.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Optional, Sequence
 
 import jax
@@ -447,3 +447,200 @@ class CanaryRunner:
         out = {"device_step_s": dt, "iters": float(iters)}
         out.update(self._throughput_from_step_time(dt))
         return out
+
+
+# -- elastic mesh reshaping ---------------------------------------------------
+
+
+@dataclass
+class _ElasticBundle:
+    """One precompiled SPMD program for one exclusion set: the mesh over
+    the surviving devices plus the sharded step and placement helpers."""
+
+    mesh: Mesh
+    cfg: CanaryConfig
+    jitted: object
+    opt: object
+    shard_params: object
+    shard_batch: object
+    shard_opt_state: object
+
+
+class ElasticCanaryRunner(CanaryRunner):
+    """Canary that reshapes its mesh around a slice under maintenance.
+
+    The zero-downtime half of the elastic-roll protocol: instead of
+    draining when a slice upgrades, the workload drops that slice's
+    devices from its mesh and keeps training.  A resize is
+    checkpoint-free —
+
+    1. snapshot params + opt-state host-side (``np.asarray`` per leaf);
+    2. switch to the bundle compiled for the new exclusion set (a mesh
+       over the surviving devices with its own sharded train step);
+    3. ``device_put`` the snapshot through the new bundle's placement
+       helpers and resume.
+
+    Per-exclusion bundles are compiled up front (``precompile=True``) so
+    the resize itself is only the host round-trip — at canary scale that
+    is below one step time, which is what lets ``max_gap_seconds``
+    report 0.00 s across an upgrade.
+
+    Two modes, picked from the device/slice arithmetic:
+
+    - **physical** (device count divides ``n_slices`` and >1 slices):
+      slice *i* owns a contiguous device block; excluding it rebuilds
+      the mesh over the remaining blocks.  The per-dp-shard batch stays
+      constant, so global batch (and throughput) scale with surviving
+      devices.
+    - **logical** (uneven split): the mesh keeps every device and an
+      exclusion shrinks the global batch proportionally instead — the
+      capacity loss is modeled even when the topology cannot be
+      physically partitioned (single-host test rigs).
+
+    ``exclude_slice``/``rejoin_slice`` are idempotent, matching the
+    coordinator's crash-replay contract.
+    """
+
+    def __init__(
+        self,
+        cfg: CanaryConfig,
+        devices: Optional[Sequence[jax.Device]] = None,
+        n_slices: int = 2,
+        seed: int = 0,
+        precompile: bool = True,
+    ) -> None:
+        if n_slices <= 0:
+            raise ValueError(f"n_slices must be positive, got {n_slices}")
+        self.base_cfg = cfg
+        devs = list(devices) if devices is not None else list(jax.devices())
+        self.devices = devs
+        self.n_slices = n_slices
+        self.physical = n_slices > 1 and len(devs) % n_slices == 0
+        if self.physical:
+            per = len(devs) // n_slices
+            self.slice_devices = [
+                devs[i * per : (i + 1) * per] for i in range(n_slices)
+            ]
+        else:
+            self.slice_devices = [list(devs) for _ in range(n_slices)]
+        base_dp = len(devs) // make_mesh(devs).shape["tp"]
+        self._per_dp_batch = max(1, cfg.batch // base_dp)
+        self.excluded: set[int] = set()
+        self._bundles: dict[frozenset, _ElasticBundle] = {}
+        rng = jax.random.PRNGKey(seed)
+        self._host_params = jax.tree.map(np.asarray, init_params(rng, cfg))
+        self.resize_events: list[dict] = []
+        self.step_times = []
+        self.losses = []
+        self._batch_rng = np.random.default_rng(seed)
+        self._activate(frozenset(), self._host_params, None)
+        if precompile:
+            self.precompile_exclusions()
+        self.window_start = time.monotonic()
+
+    # -- bundles --
+
+    def _build_bundle(self, excl: frozenset) -> _ElasticBundle:
+        if len(excl) >= self.n_slices:
+            raise ValueError("cannot exclude every slice of the workload")
+        if self.physical:
+            devs = [
+                d
+                for i in range(self.n_slices)
+                if i not in excl
+                for d in self.slice_devices[i]
+            ]
+            mesh = make_mesh(devs)
+            batch = mesh.shape["dp"] * self._per_dp_batch
+        else:
+            mesh = make_mesh(self.devices)
+            active = self.n_slices - len(excl)
+            batch = mesh.shape["dp"] * max(
+                1, self._per_dp_batch * active // self.n_slices
+            )
+        cfg = replace(self.base_cfg, batch=batch)
+        jitted, opt, sp, sb, so = make_sharded_train_step(mesh, cfg)
+        return _ElasticBundle(mesh, cfg, jitted, opt, sp, sb, so)
+
+    def _bundle_for(self, excl: frozenset) -> _ElasticBundle:
+        if excl not in self._bundles:
+            self._bundles[excl] = self._build_bundle(excl)
+        return self._bundles[excl]
+
+    def precompile_exclusions(self, exclusion_sets=None) -> None:
+        """Compile the bundles resizes will switch to, so the switch
+        itself pays no XLA compile.  Default: each single-slice
+        exclusion (the shapes a rolling upgrade visits)."""
+        sets = (
+            [frozenset(s) for s in exclusion_sets]
+            if exclusion_sets is not None
+            else [frozenset({i}) for i in range(self.n_slices)]
+        )
+        for excl in sets:
+            bundle = self._bundle_for(excl)
+            p = bundle.shard_params(self._host_params)
+            o = bundle.shard_opt_state(p, bundle.opt.init(self._host_params))
+            batch = bundle.shard_batch(
+                jnp.zeros(
+                    (bundle.cfg.batch, bundle.cfg.seq_len + 1), jnp.int32
+                )
+            )
+            # Two chained steps: the first compiles the freshly-placed
+            # signature, the second the output-fed-back signature (step
+            # outputs carry compiler-chosen shardings that differ from
+            # device_put's, and a first post-resize step would otherwise
+            # pay a recompile on its SECOND iteration).
+            p, o, loss = bundle.jitted(p, o, batch)
+            batch = bundle.shard_batch(
+                jnp.zeros(
+                    (bundle.cfg.batch, bundle.cfg.seq_len + 1), jnp.int32
+                )
+            )
+            p, o, loss = bundle.jitted(p, o, batch)
+            jax.block_until_ready(loss)
+
+    def _activate(self, excl: frozenset, host_params, host_opt) -> None:
+        bundle = self._bundle_for(excl)
+        self.mesh = bundle.mesh
+        self.cfg = bundle.cfg
+        self.params = bundle.shard_params(host_params)
+        if host_opt is None:
+            host_opt = bundle.opt.init(host_params)
+        self.opt_state = bundle.shard_opt_state(self.params, host_opt)
+        self._step = bundle.jitted
+        self._shard_batch = bundle.shard_batch
+
+    # -- resizes --
+
+    @property
+    def active_slices(self) -> int:
+        return self.n_slices - len(self.excluded)
+
+    def active_device_count(self) -> int:
+        return int(np.prod(tuple(self.mesh.shape.values())))
+
+    def _resize(self, new_excl: frozenset, direction: str, index: int) -> None:
+        t0 = time.monotonic()
+        host_p = jax.tree.map(np.asarray, self.params)
+        host_o = jax.tree.map(np.asarray, self.opt_state)
+        self.excluded = set(new_excl)
+        self._activate(new_excl, host_p, host_o)
+        self.resize_events.append(
+            {
+                "direction": direction,
+                "slice": index,
+                "seconds": time.monotonic() - t0,
+            }
+        )
+
+    def exclude_slice(self, index: int) -> None:
+        if not 0 <= index < self.n_slices:
+            raise ValueError(f"slice index {index} out of range")
+        if index in self.excluded:
+            return
+        self._resize(frozenset(self.excluded | {index}), "down", index)
+
+    def rejoin_slice(self, index: int) -> None:
+        if index not in self.excluded:
+            return
+        self._resize(frozenset(self.excluded - {index}), "up", index)

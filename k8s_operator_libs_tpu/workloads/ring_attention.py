@@ -267,3 +267,100 @@ def ring_attention_soak(
         "devices": n,
         "global_seq": S,
     }
+
+
+class ElasticRingSoak:
+    """Ring attention that re-forms its ring around excluded slices.
+
+    The context-parallel counterpart to ``ElasticCanaryRunner``: devices
+    are partitioned into ``n_slices`` contiguous blocks, and excluding a
+    slice rebuilds the ``sp`` ring over the survivors (per-device
+    sequence constant, so the global context shrinks with the ring —
+    checkpoint-free, nothing to migrate: attention is stateless).  Each
+    exclusion set's jitted program is cached on first use, so a resize
+    during a roll costs one ring re-formation, not a recompile per
+    round.  ``run_round`` verifies the shrunk ring's numerics against
+    the single-device reference every time — a reshaped ring that
+    silently corrupts attention must fail loudly, not train on garbage.
+
+    ``exclude_slice``/``rejoin_slice`` are idempotent, matching the
+    coordinator's crash-replay contract.
+    """
+
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        n_slices: int = 2,
+        seq_per_device: int = 64,
+        batch: int = 1,
+        heads: int = 2,
+        head_dim: int = 32,
+        seed: int = 0,
+    ) -> None:
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if n_slices <= 1 or len(devs) % n_slices != 0:
+            raise ValueError(
+                f"{len(devs)} devices do not partition into {n_slices} "
+                "ring slices"
+            )
+        per = len(devs) // n_slices
+        self.slice_devices = [
+            devs[i * per : (i + 1) * per] for i in range(n_slices)
+        ]
+        self.n_slices = n_slices
+        self.seq_per_device = seq_per_device
+        self.batch = batch
+        self.heads = heads
+        self.head_dim = head_dim
+        self.excluded: set[int] = set()
+        self._rings: dict[frozenset, tuple] = {}
+        self._rng = np.random.default_rng(seed)
+
+    def _ring_for(self, excl: frozenset) -> tuple:
+        if excl not in self._rings:
+            if len(excl) >= self.n_slices:
+                raise ValueError("cannot exclude every ring slice")
+            devs = [
+                d
+                for i in range(self.n_slices)
+                if i not in excl
+                for d in self.slice_devices[i]
+            ]
+            if len(devs) < 2:
+                raise ValueError("ring needs at least two devices")
+            mesh = Mesh(np.asarray(devs), ("sp",))
+            fn, shard = make_ring_attention(mesh, "sp")
+            self._rings[excl] = (fn, shard, len(devs))
+        return self._rings[excl]
+
+    def exclude_slice(self, index: int) -> None:
+        if not 0 <= index < self.n_slices:
+            raise ValueError(f"slice index {index} out of range")
+        self.excluded.add(index)
+        self._ring_for(frozenset(self.excluded))
+
+    def rejoin_slice(self, index: int) -> None:
+        self.excluded.discard(index)
+        self._ring_for(frozenset(self.excluded))
+
+    def run_round(self) -> dict:
+        """One attention pass on the current ring, verified exactly
+        against the single-device full-attention reference."""
+        fn, shard, n = self._ring_for(frozenset(self.excluded))
+        S = self.seq_per_device * n
+        shape = (self.batch, S, self.heads, self.head_dim)
+        q, k, v = (
+            shard(jnp.asarray(self._rng.standard_normal(shape), jnp.float32))
+            for _ in range(3)
+        )
+        out = jax.block_until_ready(fn(q, k, v))
+        ref = jax.block_until_ready(
+            jax.jit(full_attention_reference)(q, k, v)
+        )
+        err = float(np.max(np.abs(np.asarray(out) - np.asarray(ref))))
+        return {
+            "ok": bool(err < 5e-2),
+            "max_err": err,
+            "devices": n,
+            "global_seq": S,
+        }

@@ -63,6 +63,10 @@ def _bench_shaped_summary() -> dict:
         "fused_battery_warm_s": 0.123,
         "fused_battery_cache_hit": True,
         "fused_battery_fallbacks": 0,
+        "elastic_complete": True,
+        "elastic_downtime_s": 12.345,
+        "elastic_max_gap_s": 12.345,
+        "elastic_fallback_complete": True,
         "mxu_tflops": 179.3,
         "mxu_mfu": 0.913,
         "hbm_gbps": 771.4,

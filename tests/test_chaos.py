@@ -1353,3 +1353,224 @@ def test_node_loss_quarantine_flows_through_cached_client():
     assert saw_quarantine, "the node loss never parked the slice"
     assert mgr.quarantines_total >= 1
     assert mgr.rejoins_total >= 1
+
+
+# -- elastic rolls under chaos -----------------------------------------------
+
+
+def test_elastic_roll_node_loss_quarantine_shrink_converges():
+    """Node fault during a shrunk-mesh roll: a registered slice loses a
+    host mid-negotiation and parks in ``quarantined``.  Quarantine-shrink
+    keeps the exclusion offer open, so the workload (polling only after
+    the park — the worst case) resizes around the DEAD hardware while the
+    slice is parked; after the heal + dwell the slice resumes already
+    excluded, rolls without holding budget, and rejoins at the end.
+    Every transition must be a documented edge."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.api import ElasticCoordinationSpec
+    from k8s_operator_libs_tpu.coordination import (
+        RecordingRuntime,
+        WorkloadCoordinator,
+    )
+    from tests.test_state_diagram import EDGES, _TransitionRecorder
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(store, keys)
+    slices = _sliced_upgrade_scenario(store, keys, slices=2, hosts=2)
+    nodes = [n for ns in slices.values() for n in ns]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=1
+        ),
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        ),
+    )
+    mgr = ClusterUpgradeStateManager(
+        store, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    runtime = RecordingRuntime()
+    coordinator = WorkloadCoordinator(
+        store,
+        keys,
+        "elastic-train",
+        {sid: [n.name for n in ns_] for sid, ns_ in slices.items()},
+        runtime,
+    )
+    coordinator.register()
+
+    def member_states(name):
+        return {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[name]
+        }
+
+    def slice_excluded(name):
+        return any(
+            store.get_node(n.name, cached=False).annotations.get(
+                keys.elastic_excluded_annotation
+            )
+            == "true"
+            for n in slices[name]
+        )
+
+    in_flight = {
+        "negotiate-required", "cordon-required", "wait-for-jobs-required",
+        "pod-deletion-required", "drain-required",
+    }
+    victim = None
+    cleared = False
+    saw_quarantine = saw_excluded_while_parked = False
+    states = set()
+    for tick in range(600):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        if victim is None:
+            # Strike the first slice that enters the roll — before its
+            # workload agent has even polled the offer.
+            for name in sorted(slices):
+                if member_states(name) & in_flight:
+                    victim = (name, f"{name}-w1")
+                    store.fault_schedule = FaultSchedule().node_down(
+                        victim[1], max_hits=1
+                    )
+                    break
+        quarantined = {
+            name for name in slices if "quarantined" in member_states(name)
+        }
+        if quarantined and not saw_quarantine:
+            saw_quarantine = True
+            assert quarantined == {victim[0]}
+        if saw_quarantine:
+            # The workload agent only comes alive after the park: the
+            # quarantine-shrink offer is what it answers.
+            coordinator.poll_once()
+        if quarantined and slice_excluded(next(iter(quarantined))):
+            saw_excluded_while_parked = True
+        if saw_quarantine and not cleared:
+            store.fault_schedule.clear()
+            store.set_node_ready(victim[1], True)
+            cleared = True
+        # Budget invariant: slices that are neither quarantined nor
+        # excluded-by-resize never exceed the 1-slice budget (excluded
+        # slices hold no maxUnavailable — that is the tentpole contract).
+        down = {
+            name
+            for name, ns_ in slices.items()
+            if name not in quarantined
+            and not slice_excluded(name)
+            and any(
+                store.get_node(n.name, cached=False).spec.unschedulable
+                for n in ns_
+            )
+        }
+        assert len(down) <= 1, f"tick {tick}: budget exceeded: {sorted(down)}"
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+        if cleared and quarantined:
+            _time.sleep(0.01)  # let the 1 s ready-dwell elapse
+    else:
+        pytest.fail(f"never converged: {sorted(states)}")
+
+    assert saw_quarantine and saw_excluded_while_parked
+    assert mgr.quarantines_total >= 1 and mgr.rejoins_total >= 1
+    # Both slices were excluded and rejoined (the victim's resize ran
+    # against dead hardware, checkpoint-free).
+    assert mgr.elastic_negotiations["accept"] == 2
+    assert mgr.elastic_resizes == {"down": 2, "up": 2}
+    assert sorted(runtime.rejoined) == sorted(slices)
+    for n in nodes:
+        live = store.get_node(n.name, cached=False)
+        assert live.annotations.get(keys.elastic_excluded_annotation) in (
+            None, "", "null",
+        )
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, f"undocumented transitions: {undocumented}"
+
+
+def test_rejoin_resize_node_fault_races_quarantine_and_times_out():
+    """Rejoin-resize racing quarantine: a host of an excluded slice dies
+    while the slice waits in ``rejoin-resize-required``.  That state is
+    deliberately NOT quarantinable (its hosts are uncordoned and hold no
+    budget), so the quarantine scan must never park it; the rejoin
+    TIMEOUT path finishes the roll instead, clearing the exclusion
+    markers while the workload keeps its shrunk mesh."""
+    import time as _time
+
+    from k8s_operator_libs_tpu.api import ElasticCoordinationSpec
+    from k8s_operator_libs_tpu.upgrade import UpgradeState
+    from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(store, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=2, topology="2x2x2",
+        state=UpgradeState.REJOIN_RESIZE_REQUIRED,
+    )
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+        store.patch_node_annotations(
+            n.name, {keys.elastic_excluded_annotation: "true"}
+        )
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=1
+        ),
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=1
+        ),
+    )
+    recorder = EventRecorder()
+    mgr = ClusterUpgradeStateManager(
+        store, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0,
+        event_recorder=recorder,
+    )
+    # The hardware dies while the rejoin offer is outstanding — and
+    # never comes back.
+    store.set_node_ready(nodes[1].name, False)
+    states = set()
+    for tick in range(200):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        # The race under test: quarantine never wins over rejoin-resize.
+        assert "quarantined" not in states
+        if states == {"upgrade-done"}:
+            break
+        _time.sleep(0.02)  # let the 1 s rejoin timeout elapse
+    else:
+        pytest.fail(f"rejoin timeout never completed the roll: {states}")
+
+    assert mgr.quarantines_total == 0
+    assert mgr.elastic_resizes["up"] == 0  # no resize was absorbed
+    assert any(e.reason == "ElasticRejoinTimeout" for e in recorder.events)
+    for n in nodes:
+        live = store.get_node(n.name, cached=False)
+        assert live.annotations.get(keys.elastic_excluded_annotation) in (
+            None, "", "null",
+        )

@@ -1,0 +1,612 @@
+"""Elastic roll coordination: the annotation-mediated negotiation between
+the upgrade engine and a mesh-reshaping workload.
+
+Covers the protocol end to end (offer -> accept -> resize-complete ->
+exclusion -> rejoin-resize -> done) plus the three hard guarantees:
+
+- **Fallback parity**: a decline or offer timeout lands the slice on the
+  exact pre-coordination drain path — same downstream events, same
+  serialized budget charge as a roll with no elastic policy at all.
+- **Crash safety**: the offer epoch is a durable clock; a restarted
+  controller resumes the same negotiation and never double-offers.
+- **Fencing**: a deposed leader (higher-term adoption stamp persisted)
+  can neither absorb a down-resize nor complete a rejoin.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    ElasticCoordinationSpec,
+    IntOrString,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.coordination import (
+    RecordingRuntime,
+    WorkloadCoordinator,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import (
+    ELASTIC_RESPONSE_ACCEPT,
+    IN_PROGRESS_STATES,
+)
+from k8s_operator_libs_tpu.upgrade.durable import (
+    format_adoption_stamp,
+    make_term_fence,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+ELASTIC_KEYS = (
+    KEYS.elastic_offer_annotation,
+    KEYS.elastic_response_annotation,
+    KEYS.elastic_resize_complete_annotation,
+    KEYS.elastic_excluded_annotation,
+    KEYS.elastic_rejoin_offer_annotation,
+    KEYS.elastic_rejoin_complete_annotation,
+)
+
+
+def _rolling_cluster(slice_ids=("pool-a",), hosts=2):
+    """A bumped-DaemonSet fleet: every slice needs the h1 -> h2 roll."""
+    c = FakeCluster()
+    fx = ClusterFixture(c)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    slices = {sid: fx.tpu_slice(sid, hosts=hosts) for sid in slice_ids}
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="h1")
+    fx.bump_daemon_set_template(ds, "h2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "h2")
+    return c, fx, slices
+
+
+def _manager(c, recorder=None):
+    return ClusterUpgradeStateManager(
+        c,
+        keys=KEYS,
+        poll_interval_s=0.005,
+        poll_timeout_s=2.0,
+        event_recorder=recorder,
+    )
+
+
+def _policy(elastic=None, max_unavailable="50%", max_parallel=1):
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=max_parallel,
+        max_unavailable=IntOrString(max_unavailable),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        elastic=elastic,
+    )
+
+
+def _tick(mgr, policy):
+    mgr.apply_state(mgr.build_state(NAMESPACE, DRIVER_LABELS), policy)
+    assert mgr.wait_for_async_work()
+
+
+def _all_done(c, nodes):
+    return all(
+        c.get_node(n.name).labels.get(KEYS.state_label)
+        == UpgradeState.DONE.value
+        for n in nodes
+    )
+
+
+def _cleared(value) -> bool:
+    return value in (None, "", "null")
+
+
+def _reasons(recorder, node_name):
+    return [e.reason for e in recorder.events if e.object_name == node_name]
+
+
+def _path_reasons(recorder, node_name):
+    """Event-reason path with per-tick repeats collapsed (some reasons,
+    e.g. LIBTPUDriverUpgrade, are re-emitted every reconcile pass while a
+    state is held, so raw counts vary with tick budget)."""
+    return [
+        reason
+        for reason, _ in itertools.groupby(
+            r
+            for r in _reasons(recorder, node_name)
+            if not r.startswith("Elastic")
+        )
+    ]
+
+
+def test_accept_roll_excludes_then_rejoins_every_slice():
+    c, fx, slices = _rolling_cluster(("pool-a", "pool-b"), hosts=2)
+    all_nodes = [n for nodes in slices.values() for n in nodes]
+    recorder = EventRecorder()
+    mgr = _manager(c, recorder)
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        )
+    )
+    runtime = RecordingRuntime()
+    coordinator = WorkloadCoordinator(
+        c,
+        KEYS,
+        "train-1",
+        {sid: [n.name for n in nodes] for sid, nodes in slices.items()},
+        runtime,
+    )
+    coordinator.register()
+
+    for _ in range(80):
+        _tick(mgr, policy)
+        coordinator.poll_once()
+        if _all_done(c, all_nodes):
+            break
+    else:
+        raise AssertionError("elastic accept roll did not converge")
+
+    # Both slices were resized away and back, exactly once each (a
+    # rejoined slice leaves the currently-excluded set).
+    assert sorted(runtime.rejoined) == ["pool-a", "pool-b"]
+    assert runtime.excluded == []
+    for sid in ("pool-a", "pool-b"):
+        assert runtime.calls.count(f"exclude:{sid}") == 1
+        assert runtime.calls.count(f"rejoin:{sid}") == 1
+    assert mgr.elastic_negotiations == {"accept": 2, "decline": 0, "timeout": 0}
+    assert mgr.elastic_resizes == {"down": 2, "up": 2}
+    # Every elastic marker is retired: a finished slice is back in the
+    # ordinary budget-accounting population.
+    for n in all_nodes:
+        annotations = c.get_node(n.name, cached=False).annotations
+        for key in ELASTIC_KEYS:
+            assert _cleared(annotations.get(key)), (n.name, key)
+    # The full protocol left its audit trail on each node.
+    for n in all_nodes:
+        reasons = _reasons(recorder, n.name)
+        for expected in (
+            "ElasticOfferPosted",
+            "ElasticResizeComplete",
+            "ElasticRejoinOffered",
+            "ElasticRejoinComplete",
+        ):
+            assert expected in reasons, (n.name, expected, reasons)
+
+
+def test_excluded_slice_holds_no_unavailability_budget():
+    """maxUnavailable=1 slice normally serializes the roll.  When both
+    slices are excluded by resize they hold no budget, so the engine may
+    legally have both in disruptive states at once — something a classic
+    roll under the same policy can never do."""
+    c, fx, slices = _rolling_cluster(("pool-a", "pool-b"), hosts=2)
+    all_nodes = [n for nodes in slices.values() for n in nodes]
+    mgr = _manager(c)
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        ),
+        max_unavailable="50%",
+        max_parallel=2,
+    )
+    coordinator = WorkloadCoordinator(
+        c,
+        KEYS,
+        "train-1",
+        {sid: [n.name for n in nodes] for sid, nodes in slices.items()},
+        RecordingRuntime(),
+    )
+    coordinator.register()
+
+    overlapped = False
+    for _ in range(80):
+        _tick(mgr, policy)
+        coordinator.poll_once()
+        disruptive = set()
+        for sid, nodes in slices.items():
+            for n in nodes:
+                live = c.get_node(n.name, cached=False)
+                if live.spec.unschedulable:
+                    disruptive.add(sid)
+        if len(disruptive) == 2:
+            overlapped = True
+        if _all_done(c, all_nodes):
+            break
+    else:
+        raise AssertionError("elastic roll did not converge")
+    assert overlapped, (
+        "excluded slices should roll concurrently under a 1-slice "
+        "maxUnavailable budget (exclusion releases the claim)"
+    )
+    assert mgr.elastic_resizes == {"down": 2, "up": 2}
+
+
+def _run_roll(elastic, register, accept):
+    """Drive one two-slice roll to completion; return (cluster, manager,
+    recorder, nodes, in-flight overlap ever observed)."""
+    c, fx, slices = _rolling_cluster(("pool-a", "pool-b"), hosts=2)
+    all_nodes = [n for nodes in slices.values() for n in nodes]
+    recorder = EventRecorder()
+    mgr = _manager(c, recorder)
+    policy = _policy(elastic=elastic)
+    coordinator = None
+    if register:
+        coordinator = WorkloadCoordinator(
+            c,
+            KEYS,
+            "train-1",
+            {sid: [n.name for n in nodes] for sid, nodes in slices.items()},
+            RecordingRuntime(),
+            accept_policy=lambda sid: accept,
+        )
+        coordinator.register()
+    overlap = False
+    for _ in range(100):
+        _tick(mgr, policy)
+        if coordinator is not None:
+            coordinator.poll_once()
+        in_flight = set()
+        for sid, nodes in slices.items():
+            for n in nodes:
+                label = c.get_node(n.name).labels.get(KEYS.state_label, "")
+                if label and UpgradeState(label) in IN_PROGRESS_STATES:
+                    in_flight.add(sid)
+        if len(in_flight) > 1:
+            overlap = True
+        if _all_done(c, all_nodes):
+            break
+    else:
+        raise AssertionError("roll did not converge")
+    return c, mgr, recorder, all_nodes, overlap
+
+
+def test_decline_lands_on_exact_plain_drain_path():
+    plain_c, plain_mgr, plain_rec, plain_nodes, plain_overlap = _run_roll(
+        elastic=None, register=False, accept=True
+    )
+    el_c, el_mgr, el_rec, el_nodes, el_overlap = _run_roll(
+        elastic=ElasticCoordinationSpec(enable=True, offer_timeout_second=60),
+        register=True,
+        accept=False,
+    )
+    assert el_mgr.elastic_negotiations == {
+        "accept": 0,
+        "decline": 2,
+        "timeout": 0,
+    }
+    assert el_mgr.elastic_resizes == {"down": 0, "up": 0}
+    # Same events: beyond the negotiation prologue, every node saw the
+    # identical event sequence a pre-coordination roll produces.
+    for n in plain_nodes:
+        plain_reasons = _path_reasons(plain_rec, n.name)
+        el_reasons = _path_reasons(el_rec, n.name)
+        assert el_reasons == plain_reasons, (n.name, el_reasons, plain_reasons)
+        assert "ElasticDeclined" in _reasons(el_rec, n.name)
+    # Same budget charge: the declined claim is KEPT, so the roll stays
+    # serialized exactly like the plain one (never two slices in flight).
+    assert not plain_overlap
+    assert not el_overlap
+    # Annotation-identical end state: no elastic marker survives.
+    for n in el_nodes:
+        annotations = el_c.get_node(n.name, cached=False).annotations
+        for key in ELASTIC_KEYS:
+            assert _cleared(annotations.get(key)), (n.name, key)
+
+
+def test_offer_timeout_lands_on_exact_plain_drain_path():
+    plain_c, plain_mgr, plain_rec, plain_nodes, _ = _run_roll(
+        elastic=None, register=False, accept=True
+    )
+    # Registered workload that never answers: zero timeout makes the
+    # engine give up on the pass after the offer is posted.
+    c, fx, slices = _rolling_cluster(("pool-a", "pool-b"), hosts=2)
+    all_nodes = [n for nodes in slices.values() for n in nodes]
+    recorder = EventRecorder()
+    mgr = _manager(c, recorder)
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(enable=True, offer_timeout_second=0)
+    )
+    for nodes in slices.values():
+        for n in nodes:
+            c.patch_node_annotations(
+                n.name, {KEYS.elastic_workload_annotation: "train-1"}
+            )
+    for _ in range(100):
+        _tick(mgr, policy)
+        if _all_done(c, all_nodes):
+            break
+    else:
+        raise AssertionError("timeout fallback roll did not converge")
+    assert mgr.elastic_negotiations == {"accept": 0, "decline": 0, "timeout": 2}
+    assert mgr.elastic_resizes == {"down": 0, "up": 0}
+    for n in plain_nodes:
+        plain_reasons = _path_reasons(plain_rec, n.name)
+        el_reasons = _path_reasons(recorder, n.name)
+        assert el_reasons == plain_reasons, (n.name, el_reasons, plain_reasons)
+        assert "ElasticOfferTimeout" in _reasons(recorder, n.name)
+
+
+def test_controller_crash_mid_negotiation_never_double_offers():
+    c, fx, slices = _rolling_cluster(("pool-a",), hosts=2)
+    nodes = slices["pool-a"]
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(enable=True, offer_timeout_second=60)
+    )
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name, {KEYS.elastic_workload_annotation: "train-1"}
+        )
+    rec1 = EventRecorder()
+    mgr1 = _manager(c, rec1)
+    for _ in range(5):
+        _tick(mgr1, policy)
+        offers = {
+            c.get_node(n.name, cached=False).annotations.get(
+                KEYS.elastic_offer_annotation
+            )
+            for n in nodes
+        }
+        if offers and all(o and not _cleared(o) for o in offers):
+            break
+    else:
+        raise AssertionError("offer never posted")
+    assert len(offers) == 1, "offer epoch must be slice-uniform"
+    original_offer = offers.pop()
+    posted = sum(
+        1 for e in rec1.events if e.reason == "ElasticOfferPosted"
+    )
+    assert posted == len(nodes)
+
+    # Controller crash: a brand-new incarnation picks the fleet up.
+    rec2 = EventRecorder()
+    mgr2 = _manager(c, rec2)
+    for _ in range(3):
+        _tick(mgr2, policy)
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        # The durable clock survived verbatim: same epoch, no re-stamp.
+        assert (
+            live.annotations.get(KEYS.elastic_offer_annotation)
+            == original_offer
+        )
+        assert (
+            live.labels[KEYS.state_label]
+            == UpgradeState.NEGOTIATE_REQUIRED.value
+        )
+    assert not any(
+        e.reason == "ElasticOfferPosted" for e in rec2.events
+    ), "restarted controller re-posted the exclusion offer"
+
+    # The resumed negotiation still completes against the original offer.
+    coordinator = WorkloadCoordinator(
+        c, KEYS, "train-1", {"pool-a": [n.name for n in nodes]},
+        RecordingRuntime(),
+    )
+    coordinator.poll_once()
+    _tick(mgr2, policy)
+    assert mgr2.elastic_negotiations["accept"] == 1
+    assert mgr1.elastic_negotiations["accept"] == 0
+
+
+def test_deposed_leader_cannot_absorb_a_completed_resize():
+    c, fx, slices = _rolling_cluster(("pool-a",), hosts=2)
+    nodes = slices["pool-a"]
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(enable=True, offer_timeout_second=60)
+    )
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name, {KEYS.elastic_workload_annotation: "train-1"}
+        )
+    mgr = _manager(c)
+    for _ in range(5):
+        _tick(mgr, policy)
+        if any(
+            KEYS.elastic_offer_annotation
+            in c.get_node(n.name, cached=False).annotations
+            for n in nodes
+        ):
+            break
+    # The workload accepts and finishes its down-resize...
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {
+                KEYS.elastic_response_annotation: ELASTIC_RESPONSE_ACCEPT,
+                KEYS.elastic_resize_complete_annotation: str(int(time.time())),
+            },
+        )
+    # ...but a successor has already adopted the nodes at a higher term.
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {KEYS.adopted_by_annotation: format_adoption_stamp("succ", 9)},
+        )
+    mgr.term_fence = make_term_fence(c, KEYS, lambda: 4)
+    for _ in range(2):
+        _tick(mgr, policy)
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        # Deposed: no exclusion stamped, no state flip, no counter.
+        assert _cleared(live.annotations.get(KEYS.elastic_excluded_annotation))
+        assert (
+            live.labels[KEYS.state_label]
+            == UpgradeState.NEGOTIATE_REQUIRED.value
+        )
+    assert mgr.elastic_negotiations["accept"] == 0
+
+    # The CURRENT-term leader absorbs the very same response.
+    successor = _manager(c)
+    successor.term_fence = make_term_fence(c, KEYS, lambda: 9)
+    _tick(successor, policy)
+    assert successor.elastic_negotiations["accept"] == 1
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        assert (
+            live.annotations.get(KEYS.elastic_excluded_annotation) == "true"
+        )
+
+
+def test_deposed_leader_cannot_complete_a_rejoin_resize():
+    c = FakeCluster()
+    fx = ClusterFixture(c)
+    ds = fx.daemon_set(hash_suffix="h1", revision=1)
+    nodes = fx.tpu_slice(
+        "pool-a", hosts=2, state=UpgradeState.REJOIN_RESIZE_REQUIRED
+    )
+    for n in nodes:
+        fx.driver_pod(n, ds, hash_suffix="h1")
+    past = str(int(time.time()) - 5)
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {
+                KEYS.elastic_excluded_annotation: "true",
+                KEYS.elastic_rejoin_offer_annotation: past,
+                KEYS.elastic_rejoin_complete_annotation: str(int(time.time())),
+                KEYS.adopted_by_annotation: format_adoption_stamp("succ", 9),
+            },
+        )
+    policy = _policy(
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        )
+    )
+    deposed = _manager(c)
+    deposed.term_fence = make_term_fence(c, KEYS, lambda: 4)
+    for _ in range(2):
+        _tick(deposed, policy)
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        assert (
+            live.labels[KEYS.state_label]
+            == UpgradeState.REJOIN_RESIZE_REQUIRED.value
+        )
+        assert live.annotations.get(KEYS.elastic_excluded_annotation) == "true"
+    assert deposed.elastic_resizes["up"] == 0
+
+    successor = _manager(c)
+    successor.term_fence = make_term_fence(c, KEYS, lambda: 9)
+    _tick(successor, policy)
+    assert successor.elastic_resizes["up"] == 1
+    for n in nodes:
+        live = c.get_node(n.name, cached=False)
+        assert live.labels[KEYS.state_label] == UpgradeState.DONE.value
+        assert _cleared(live.annotations.get(KEYS.elastic_excluded_annotation))
+
+
+# -- WorkloadCoordinator unit behaviour (RecordingRuntime, no engine) -------
+
+
+def _coordinator_cluster(accept_policy=None, runtime=None):
+    c = FakeCluster()
+    fx = ClusterFixture(c)
+    nodes = fx.tpu_slice("pool-a", hosts=2)
+    runtime = runtime or RecordingRuntime()
+    coordinator = WorkloadCoordinator(
+        c,
+        KEYS,
+        "train-1",
+        {"pool-a": [n.name for n in nodes]},
+        runtime,
+        accept_policy=accept_policy,
+    )
+    return c, nodes, runtime, coordinator
+
+
+def _post_offer(c, nodes):
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name, {KEYS.elastic_offer_annotation: str(int(time.time()))}
+        )
+
+
+def test_coordinator_accepts_and_stamps_resize_complete():
+    c, nodes, runtime, coordinator = _coordinator_cluster()
+    coordinator.register()
+    assert coordinator.poll_once() == {}  # no offer yet
+    _post_offer(c, nodes)
+    assert coordinator.poll_once() == {"pool-a": "resize-complete"}
+    assert runtime.excluded == ["pool-a"]
+    for n in nodes:
+        annotations = c.get_node(n.name, cached=False).annotations
+        assert (
+            annotations[KEYS.elastic_response_annotation]
+            == ELASTIC_RESPONSE_ACCEPT
+        )
+        assert int(annotations[KEYS.elastic_resize_complete_annotation]) > 0
+    # Replaying the sweep is a no-op: the stamped protocol state gates it.
+    assert coordinator.poll_once() == {}
+    assert runtime.calls.count("exclude:pool-a") == 1
+
+
+def test_coordinator_decline_policy_stamps_decline_and_keeps_mesh():
+    c, nodes, runtime, coordinator = _coordinator_cluster(
+        accept_policy=lambda sid: False
+    )
+    _post_offer(c, nodes)
+    assert coordinator.poll_once() == {"pool-a": "declined"}
+    assert runtime.excluded == []
+    for n in nodes:
+        annotations = c.get_node(n.name, cached=False).annotations
+        assert annotations[KEYS.elastic_response_annotation] == "decline"
+        assert (
+            KEYS.elastic_resize_complete_annotation not in annotations
+            or _cleared(
+                annotations.get(KEYS.elastic_resize_complete_annotation)
+            )
+        )
+    assert coordinator.poll_once() == {}  # declined stays declined
+
+
+def test_coordinator_resize_failure_reports_decline():
+    c, nodes, runtime, coordinator = _coordinator_cluster(
+        runtime=RecordingRuntime(fail_exclude=True)
+    )
+    _post_offer(c, nodes)
+    assert coordinator.poll_once() == {"pool-a": "resize-failed"}
+    for n in nodes:
+        annotations = c.get_node(n.name, cached=False).annotations
+        # The controller sees a decline and falls back to draining
+        # immediately instead of waiting out the offer timeout.
+        assert annotations[KEYS.elastic_response_annotation] == "decline"
+
+
+def test_coordinator_crash_replay_finishes_interrupted_resize():
+    """Accept stamped but the agent died before the resize completed:
+    the replayed sweep reruns the (idempotent) resize and stamps
+    completion against the same offer."""
+    c, nodes, runtime, coordinator = _coordinator_cluster()
+    _post_offer(c, nodes)
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {KEYS.elastic_response_annotation: ELASTIC_RESPONSE_ACCEPT},
+        )
+    assert coordinator.poll_once() == {"pool-a": "resize-complete"}
+    assert runtime.excluded == ["pool-a"]
+
+
+def test_coordinator_rejoin_offer_takes_precedence():
+    c, nodes, runtime, coordinator = _coordinator_cluster()
+    for n in nodes:
+        c.patch_node_annotations(
+            n.name,
+            {
+                KEYS.elastic_rejoin_offer_annotation: str(int(time.time())),
+            },
+        )
+    assert coordinator.poll_once() == {"pool-a": "rejoin-complete"}
+    assert runtime.rejoined == ["pool-a"]
+    for n in nodes:
+        annotations = c.get_node(n.name, cached=False).annotations
+        assert int(annotations[KEYS.elastic_rejoin_complete_annotation]) > 0
+    assert coordinator.poll_once() == {}

@@ -667,3 +667,145 @@ def test_watch_killed_mid_roll_cache_reconverges(seed):
         f"seed {seed}: undocumented transitions {undocumented}"
     )
     assert recorder.observed
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_elastic_rolls_excluded_slices_hold_no_budget(seed):
+    """Elastic fuzz rule: slices the workload resized AROUND (excluded)
+    never hold ``maxUnavailable``.  Random fleets roll with a 1-slice
+    budget while each slice's workload agent randomly accepts or
+    declines the exclusion offer; every tick, cordoned-but-excluded
+    slices must not count against the budget — and at least once the
+    engine must actually SPEND the freed budget on another slice while
+    an excluded one is still cordoned (the release is real, not just
+    never observed).  Declined slices take the classic budgeted path;
+    every transition must be a documented edge and every exclusion must
+    end rejoined with the protocol annotations cleared."""
+    from k8s_operator_libs_tpu.api import ElasticCoordinationSpec
+    from k8s_operator_libs_tpu.coordination import (
+        RecordingRuntime,
+        WorkloadCoordinator,
+    )
+
+    rng = random.Random(9000 + seed)
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(cluster, keys)
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    n_slices = rng.randint(2, 4)
+    slices = {
+        f"pool-{i}": fx.tpu_slice(f"pool-{i}", hosts=2, topology="2x2x2")
+        for i in range(n_slices)
+    }
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        # >= 2 so a second slice is in flight while an exclusion holds:
+        # the budget-respend window below needs concurrent admission
+        # (max_parallel=1 serializes the roll and the freed budget has
+        # no taker).
+        max_parallel_upgrades=rng.randint(2, 3),
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=1),
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        ),
+    )
+    # Random accept/decline mix, but the FIRST slice always accepts so
+    # the budget-respend window below is reachable in every seed.
+    accepts = {sid: rng.random() < 0.6 for sid in slices}
+    accepts["pool-0"] = True
+    runtime = RecordingRuntime()
+    coordinator = WorkloadCoordinator(
+        cluster,
+        keys,
+        f"fuzz-elastic-{seed}",
+        {sid: [n.name for n in ns] for sid, ns in slices.items()},
+        runtime,
+        accept_policy=lambda sid: accepts[sid],
+    )
+    coordinator.register()
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    def slice_excluded(name):
+        return any(
+            cluster.get_node(n.name, cached=False).annotations.get(
+                keys.elastic_excluded_annotation
+            )
+            == "true"
+            for n in slices[name]
+        )
+
+    saw_respend = False
+    states: set = set()
+    for tick in range(400):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        assert mgr.wait_for_async_work(30.0)
+        coordinator.poll_once()
+
+        cordoned = {
+            name
+            for name, ns_ in slices.items()
+            if any(
+                cluster.get_node(n.name, cached=False).spec.unschedulable
+                for n in ns_
+            )
+        }
+        excluded = {name for name in cordoned if slice_excluded(name)}
+        charged = cordoned - excluded
+        assert len(charged) <= 1, (
+            f"seed {seed} tick {tick}: non-excluded slices {sorted(charged)}"
+            f" exceed the 1-slice budget (excluded: {sorted(excluded)})"
+        )
+        if len(cordoned) > 1:
+            # More slices cordoned than the budget allows — legal ONLY
+            # because the excluded ones hold no charge: the freed budget
+            # was respent while an exclusion was still in flight.
+            saw_respend = True
+
+        states = {
+            cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for nodes in slices.values()
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+    else:
+        pytest.fail(
+            f"seed {seed}: elastic roll never converged "
+            f"(states {sorted(states)})"
+        )
+
+    n_accept = sum(accepts.values())
+    assert saw_respend, (
+        f"seed {seed}: never observed the budget respent while an "
+        f"excluded slice was cordoned — the release path was not hit"
+    )
+    assert mgr.elastic_negotiations.get("accept", 0) == n_accept
+    assert mgr.elastic_negotiations.get("decline", 0) == n_slices - n_accept
+    assert mgr.elastic_resizes == {"down": n_accept, "up": n_accept}
+    assert sorted(runtime.rejoined) == sorted(
+        sid for sid, ok in accepts.items() if ok
+    )
+    assert runtime.excluded == []
+    for nodes in slices.values():
+        for n in nodes:
+            live = cluster.get_node(n.name, cached=False)
+            assert live.annotations.get(
+                keys.elastic_excluded_annotation
+            ) in (None, "", "null")
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, (
+        f"seed {seed}: undocumented transitions {undocumented}"
+    )

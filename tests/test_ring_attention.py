@@ -84,3 +84,41 @@ def test_deep_probe_single_device_vacuous(cpu_devices):
     res = ici_ring_attention_probe(cpu_devices[:1])
     assert res.ok
     assert "single device" in res.detail
+
+
+def test_elastic_ring_resize_numerics(cpu_devices):
+    """The ring re-forms around an excluded slice and the shrunk ring's
+    attention still matches the full reference exactly."""
+    from k8s_operator_libs_tpu.workloads.ring_attention import ElasticRingSoak
+
+    soak = ElasticRingSoak(
+        cpu_devices, n_slices=4, seq_per_device=16, heads=2, head_dim=8
+    )
+    full = soak.run_round()
+    assert full["ok"], full
+    assert full["devices"] == 8 and full["global_seq"] == 16 * 8
+
+    soak.exclude_slice(2)
+    shrunk = soak.run_round()
+    assert shrunk["ok"], shrunk
+    assert shrunk["devices"] == 6 and shrunk["global_seq"] == 16 * 6
+
+    soak.exclude_slice(2)  # idempotent replay
+    assert soak.excluded == {2}
+    soak.rejoin_slice(2)
+    regrown = soak.run_round()
+    assert regrown["ok"], regrown
+    assert regrown["devices"] == 8
+
+
+def test_elastic_ring_rejects_bad_partitions(cpu_devices):
+    import pytest
+
+    from k8s_operator_libs_tpu.workloads.ring_attention import ElasticRingSoak
+
+    with pytest.raises(ValueError):
+        ElasticRingSoak(cpu_devices, n_slices=3)  # 8 % 3 != 0
+    soak = ElasticRingSoak(cpu_devices, n_slices=2, seq_per_device=8)
+    soak.exclude_slice(0)
+    with pytest.raises(ValueError):
+        soak.exclude_slice(1)  # would empty the ring

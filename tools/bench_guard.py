@@ -82,6 +82,19 @@ BATTERY_WARM_CEILING_S = 1.0
 # warm compile cache, including worker-thread handoff latency.
 VALIDATION_WALL_CEILING_S = 10.0
 
+# Elastic-roll stage: 4 operator slices mapped onto the 8-device CPU
+# mesh (2 devices per slice), rolled end-to-end through the negotiation
+# protocol with a live ElasticCanaryRunner.
+ELASTIC_N_SLICES = 4
+# The canary steps every few ms and a precompiled resize costs ~one
+# step, so across a WHOLE 4-slice roll the longest inter-step gap stays
+# at canary-step granularity.  The ceiling is ~100 canary steps — tight
+# enough that any drain fallback (the job parked while pods restart,
+# seconds at minimum) or a resize that recompiles trips it, loose
+# enough for CI scheduler noise.  Downtime is reported as 0.00 s only
+# when the gap stays under it.
+ELASTIC_GAP_CEILING_S = 0.5
+
 
 def measure(
     slices: int = N_SLICES,
@@ -352,6 +365,157 @@ def measure_probe_battery() -> dict:
     }
 
 
+def measure_elastic(
+    accept: bool = True, devices=None, pin_cpu: bool = True
+) -> dict:
+    """One end-to-end elastic roll; returns the artifact dict (also
+    embedded in BENCH_DETAILS.json by bench.py).
+
+    ``accept=True`` rolls every slice through the negotiation protocol
+    with a live ElasticCanaryRunner answering offers and measures the
+    canary's longest inter-step gap across the whole roll — the
+    zero-downtime headline.  ``accept=False`` declines every offer and
+    verifies the roll still completes end-to-end on the drain path.
+
+    Standalone (bench-guard) the stage pins the process to the 8-device
+    virtual CPU mesh; bench.py passes its real ``devices`` with
+    ``pin_cpu=False`` (pinning would repoint the whole bench process)."""
+    import time
+
+    os.environ.setdefault("K8S_TPU_PROBE_MIN_TIME_S", "0.01")
+    if pin_cpu:
+        from k8s_operator_libs_tpu import hostenv
+
+        hostenv.pin_current_process_to_cpu(default_host_device_count=8)
+
+    import jax
+
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        ElasticCoordinationSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.coordination import (
+        RunnerElasticRuntime,
+        WorkloadCoordinator,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+    from k8s_operator_libs_tpu.workloads.canary import (
+        CanaryConfig,
+        ElasticCanaryRunner,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+    from test_upgrade_state import FakeProber
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    slice_ids = [f"pool-{i}" for i in range(ELASTIC_N_SLICES)]
+    slice_nodes = {}
+    for sid in slice_ids:
+        nodes = fx.tpu_slice(sid, hosts=1)
+        slice_nodes[sid] = [n.name for n in nodes]
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    mgr.with_validation_enabled(FakeProber(healthy=True))
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString("25%"),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        elastic=ElasticCoordinationSpec(
+            enable=True, offer_timeout_second=60, rejoin_timeout_second=60
+        ),
+    )
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    cfg = CanaryConfig(
+        vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        seq_len=16, batch=8,
+    )
+    runner = ElasticCanaryRunner(
+        cfg, devices=devs, n_slices=ELASTIC_N_SLICES, seed=0
+    )
+    coordinator = WorkloadCoordinator(
+        cluster,
+        keys,
+        "bench-canary",
+        slice_nodes,
+        RunnerElasticRuntime(
+            runner, {sid: i for i, sid in enumerate(slice_ids)}
+        ),
+        accept_policy=lambda sid: accept,
+    )
+    coordinator.register()
+
+    for _ in range(4):  # warmup: compiles stay out of the gap window
+        runner.run_step()
+    runner.reset_timing()
+
+    all_names = [nm for names in slice_nodes.values() for nm in names]
+    converged = False
+    for _ in range(200):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        if not mgr.wait_for_async_work(10.0):
+            raise RuntimeError("async upgrade work did not drain")
+        coordinator.poll_once()
+        for _ in range(3):
+            runner.run_step()
+        if all(
+            cluster.get_node(nm).labels.get(keys.state_label)
+            == UpgradeState.DONE.value
+            for nm in all_names
+        ):
+            converged = True
+            break
+    max_gap_s = runner.max_gap_seconds(until=time.monotonic())
+    perf = runner.perf_summary()
+    # Downtime at canary-step granularity: a gap under the ceiling is
+    # the normal step cadence (resize included), not an interruption.
+    downtime_s = 0.0 if max_gap_s <= ELASTIC_GAP_CEILING_S else max_gap_s
+
+    leftover_excluded = sum(
+        1
+        for nm in all_names
+        if cluster.get_node(nm).annotations.get(
+            keys.elastic_excluded_annotation
+        )
+        == "true"
+    )
+    return {
+        "variant": "accept" if accept else "decline",
+        "slices": ELASTIC_N_SLICES,
+        "devices": len(devs),
+        "physical_partition": runner.physical,
+        "converged": converged,
+        "downtime_s": round(downtime_s, 2),
+        "max_gap_s": round(max_gap_s, 4),
+        "median_step_s": perf.get("median_step_s", 0.0),
+        "canary_steps": len(runner.step_times),
+        "negotiations": dict(mgr.elastic_negotiations),
+        "resizes": dict(mgr.elastic_resizes),
+        "runner_resizes": len(runner.resize_events),
+        "leftover_excluded": leftover_excluded,
+        "gap_ceiling_s": ELASTIC_GAP_CEILING_S,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -439,6 +603,67 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (battery): {f}", file=sys.stderr)
+        return 1
+
+    elastic = measure_elastic(accept=True)
+    failures = []
+    if not elastic["converged"]:
+        failures.append("elastic roll did not converge to upgrade-done")
+    if elastic["downtime_s"] != 0.0:
+        failures.append(
+            f"elastic roll downtime {elastic['downtime_s']}s != 0.00s "
+            f"(max canary gap {elastic['max_gap_s']}s > ceiling "
+            f"{ELASTIC_GAP_CEILING_S}s — a resize recompiled or the "
+            "roll fell back to draining)"
+        )
+    if elastic["negotiations"].get("accept", 0) != ELASTIC_N_SLICES:
+        failures.append(
+            f"{elastic['negotiations']} accepted negotiations != "
+            f"{ELASTIC_N_SLICES} slices"
+        )
+    if elastic["resizes"].get("down", 0) != ELASTIC_N_SLICES or elastic[
+        "resizes"
+    ].get("up", 0) != ELASTIC_N_SLICES:
+        failures.append(
+            f"resize counters {elastic['resizes']} != {ELASTIC_N_SLICES} "
+            "down + up (a slice skipped the exclude/rejoin cycle)"
+        )
+    if elastic["leftover_excluded"]:
+        failures.append(
+            f"{elastic['leftover_excluded']} node(s) still carry the "
+            "excluded marker after the roll"
+        )
+    elastic["ok"] = not failures
+    print(json.dumps(elastic, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (elastic): {f}", file=sys.stderr)
+        return 1
+
+    fallback = measure_elastic(accept=False)
+    failures = []
+    if not fallback["converged"]:
+        failures.append(
+            "declined elastic roll did not complete on the drain path"
+        )
+    if fallback["negotiations"].get("decline", 0) != ELASTIC_N_SLICES:
+        failures.append(
+            f"{fallback['negotiations']} declined negotiations != "
+            f"{ELASTIC_N_SLICES} slices"
+        )
+    if fallback["resizes"].get("down", 0) or fallback["resizes"].get("up", 0):
+        failures.append(
+            f"declined roll still resized the workload: "
+            f"{fallback['resizes']}"
+        )
+    fallback["ok"] = not failures
+    print(json.dumps(fallback, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(
+                f"bench-guard FAIL (elastic fallback): {f}",
+                file=sys.stderr,
+            )
         return 1
     return 0
 
