@@ -1351,6 +1351,7 @@ def main() -> None:
     from bench_guard import measure as measure_cached_reconcile  # noqa: E402
     from bench_guard import (  # noqa: E402
         measure_elastic as measure_elastic_roll,
+        measure_heterogeneous as measure_heterogeneous_roll,
         measure_sharded as measure_sharded_reconcile,
     )
 
@@ -1381,6 +1382,16 @@ def main() -> None:
     )
     beat()
     log(f"elastic roll (decline fallback): {elastic_fallback}")
+
+    # -- heterogeneous fleet: mixed-generation pools (gated by
+    # `make bench-guard`) --------------------------------------------------
+    # One CR rolls v4 + v5e + v6e pools under a serial fleet budget:
+    # oldest generation is admitted first, and the window-held v6e pool
+    # makes zero transitions and holds zero budget until its
+    # maintenance window opens.
+    heterogeneous = measure_heterogeneous_roll()
+    beat()
+    log(f"heterogeneous roll (v4+v5e+v6e pools): {heterogeneous}")
 
     complete = seq_result["complete"]
     details = {
@@ -1435,6 +1446,7 @@ def main() -> None:
             "accept": elastic_roll,
             "decline_fallback": elastic_fallback,
         },
+        "heterogeneous": heterogeneous,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),

@@ -73,6 +73,8 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("SliceQuarantineSpec", "ready_dwell_second"): {"minimum": 0},
     ("ElasticCoordinationSpec", "offer_timeout_second"): {"minimum": 0},
     ("ElasticCoordinationSpec", "rejoin_timeout_second"): {"minimum": 0},
+    ("PoolSpec", "name"): {"pattern": "^.+$"},
+    ("PoolSpec", "max_parallel_upgrades"): {"minimum": 0},
 }
 
 
@@ -142,7 +144,28 @@ def spec_schema(cls: type = TPUUpgradePolicySpec) -> dict[str, Any]:
     for f in fields(cls):
         hint = _unwrap_optional(hints[f.name])
         key = _JSON_NAME_OVERRIDES.get(f.name, _camel(f.name))
-        if isinstance(hint, type) and issubclass(hint, _SpecBase):
+        origin = get_origin(hint)
+        if origin is list:
+            (item_hint,) = get_args(hint)
+            if isinstance(item_hint, type) and issubclass(
+                item_hint, _SpecBase
+            ):
+                items = spec_schema(item_hint)
+            elif item_hint is str:
+                items = {"type": "string"}
+            else:  # pragma: no cover - no such list item types today
+                raise TypeError(
+                    f"{cls.__name__}.{f.name}: unmapped list item "
+                    f"type {item_hint!r}"
+                )
+            sub = {"type": "array", "items": items}
+        elif origin is dict:
+            # Only string->string maps appear today (node selectors).
+            sub = {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+            }
+        elif isinstance(hint, type) and issubclass(hint, _SpecBase):
             sub = spec_schema(hint)
         elif hint is IntOrString:
             # apiextensions IntOrString marker (reference
@@ -296,12 +319,26 @@ def validate_object(
             errors.append(f"{path}: must be an integer or a string")
         return errors
     typ = schema.get("type")
+    if typ == "array":
+        if not isinstance(obj, list):
+            return [f"{path}: must be an array, got {type(obj).__name__}"]
+        items = schema.get("items", {})
+        for i, item in enumerate(obj):
+            errors.extend(validate_object(item, items, f"{path}[{i}]"))
+        return errors
     if typ == "object":
         if not isinstance(obj, dict):
             return [f"{path}: must be an object, got {type(obj).__name__}"]
-        props = schema.get("properties", {})
         if schema.get("x-kubernetes-preserve-unknown-fields"):
             return errors
+        extra = schema.get("additionalProperties")
+        if extra is not None and "properties" not in schema:
+            # Map type (e.g. a node selector): every value validates
+            # against the additionalProperties schema, any key admitted.
+            for key, val in obj.items():
+                errors.extend(validate_object(val, extra, f"{path}.{key}"))
+            return errors
+        props = schema.get("properties", {})
         for key, val in obj.items():
             sub = props.get(key)
             if sub is None:

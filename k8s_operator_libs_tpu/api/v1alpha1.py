@@ -104,11 +104,17 @@ class _SpecBase:
             v = getattr(self, f.name)
             if v is None:
                 continue
+            if isinstance(v, list) and not v:
+                continue  # empty list = unset, like None
             key = _JSON_NAME_OVERRIDES.get(f.name, _camel(f.name))
             if isinstance(v, _SpecBase):
                 out[key] = v.to_dict()
             elif isinstance(v, IntOrString):
                 out[key] = v.value
+            elif isinstance(v, list):
+                out[key] = [
+                    x.to_dict() if isinstance(x, _SpecBase) else x for x in v
+                ]
             else:
                 out[key] = v
         return out
@@ -128,7 +134,9 @@ class _SpecBase:
                 # prunes nulls and applies the field default.
                 continue
             typ = _NESTED_TYPES.get((cls.__name__, f.name))
-            if typ is not None and raw is not None:
+            if typ is not None and isinstance(raw, list):
+                kwargs[f.name] = [typ.from_dict(item) for item in raw]
+            elif typ is not None and raw is not None:
                 kwargs[f.name] = typ.from_dict(raw)
             elif f.name == "max_unavailable" and raw is not None:
                 kwargs[f.name] = IntOrString.parse(raw)
@@ -396,6 +404,76 @@ class ElasticCoordinationSpec(_SpecBase):
 
 
 @dataclass
+class MaintenanceWindowSpec(_SpecBase):
+    """Cron-style UTC maintenance window for one pool (new component).
+
+    The expression is a standard 5-field cron (minute hour day-of-month
+    month day-of-week, UTC) read as a *membership test*: the window is
+    open at an instant iff every field matches, so ``"* 2-5 * * 6,0"``
+    means 02:00-05:59 UTC on weekends.  Outside the window the pool's
+    groups hold in a budget-free ``window-wait`` condition — no state
+    transitions, no budget charge — and resume where they stopped when
+    the window opens.
+    """
+
+    # 5-field cron membership expression, UTC.  "* * * * *" = always open.
+    cron: str = "* * * * *"
+
+    def validate(self) -> None:
+        from k8s_operator_libs_tpu.fleet.windows import validate_window
+
+        try:
+            validate_window(self.cron)
+        except ValueError as e:
+            raise ValidationError(f"maintenanceWindow.cron: {e}") from e
+
+
+@dataclass
+class PoolSpec(_SpecBase):
+    """One pool of a heterogeneous fleet (new component).
+
+    A pool is a labelled subset of the managed nodes — typically one
+    device generation — with its own roll envelope: target driver
+    version, budget overrides, and an optional maintenance window.
+    Budgets compose as a hierarchy: an admission must fit the FLEET caps
+    and the pool's own caps simultaneously.
+    """
+
+    # Pool identity (required, unique within the policy).
+    name: str = ""
+    # Label selector matching this pool's nodes (all pairs must match),
+    # e.g. {"cloud.google.com/gke-tpu-accelerator": "tpu-v4-podslice"}.
+    node_selector: dict[str, str] = field(default_factory=dict)
+    # Target driver version for this pool's DaemonSet (informational +
+    # surfaced in status; the DaemonSet template hash remains the
+    # authoritative "outdated" predicate).
+    driver_version: str = ""
+    # Per-pool maxUnavailable override; unset inherits the fleet cap.
+    max_unavailable: Optional[IntOrString] = None
+    # Per-pool maxParallelUpgrades override; unset inherits, 0 = unlimited
+    # within the pool (the fleet cap still applies).
+    max_parallel_upgrades: Optional[int] = None
+    # Optional maintenance window; unset = always open.
+    maintenance_window: Optional[MaintenanceWindowSpec] = None
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("pool.name must be non-empty")
+        if (
+            self.max_parallel_upgrades is not None
+            and self.max_parallel_upgrades < 0
+        ):
+            raise ValidationError(
+                f"pool {self.name!r}: maxParallelUpgrades must be >= 0"
+            )
+        if self.maintenance_window is not None:
+            try:
+                self.maintenance_window.validate()
+            except ValidationError as e:
+                raise ValidationError(f"pool {self.name!r}: {e}") from e
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -444,6 +522,10 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # Elastic roll coordination: negotiate workload mesh reshaping before
     # cordoning a slice (None/disabled = today's drain rolls unchanged).
     elastic: Optional[ElasticCoordinationSpec] = None
+    # Heterogeneous-fleet pools: per-generation node subsets, each with
+    # its own driver target, budget overrides, and maintenance window.
+    # Empty = the whole fleet is one implicit pool (prior behavior).
+    pools: list[PoolSpec] = field(default_factory=list)
 
     def validate(self) -> None:
         super().validate()
@@ -462,6 +544,12 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.slice_quarantine.validate()
         if self.elastic is not None:
             self.elastic.validate()
+        seen_pools: set[str] = set()
+        for pool in self.pools:
+            pool.validate()
+            if pool.name in seen_pools:
+                raise ValidationError(f"duplicate pool name {pool.name!r}")
+            seen_pools.add(pool.name)
 
 
 # Nested-type registry for from_dict (maps (class, field) -> spec type).
@@ -477,4 +565,7 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("TPUUpgradePolicySpec", "health_gate"): SliceHealthGateSpec,
     ("TPUUpgradePolicySpec", "slice_quarantine"): SliceQuarantineSpec,
     ("TPUUpgradePolicySpec", "elastic"): ElasticCoordinationSpec,
+    # List-of-nested: from_dict maps each element through the type.
+    ("TPUUpgradePolicySpec", "pools"): PoolSpec,
+    ("PoolSpec", "maintenance_window"): MaintenanceWindowSpec,
 }

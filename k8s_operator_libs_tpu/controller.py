@@ -82,6 +82,13 @@ class ControllerConfig:
     # only for environments whose probe hosts are not the accelerator the
     # slice labels claim (CPU test rigs).
     hbm_floor_fraction: float = 0.5
+    # Resolve HBM/ICI health-gate floors from the fleet GenerationProfile
+    # registry (fleet.profiles) when no explicit floor is configured, so a
+    # mixed v4/v5e/v6e fleet gates each pool at its own generation's spec.
+    # Off by default: the fraction-based floor above stays the reference
+    # wiring, and CPU test rigs carry accelerator labels whose published
+    # ICI spec their fake reports can't meet.
+    generation_floors: bool = False
     # (namespace, name) of a TPUUpgradePolicy CR to read the policy from
     # each pass instead of a static ``policy`` — the consumer-operator
     # pattern (reference SURVEY §1: "policy flows in from the consumer's
@@ -205,6 +212,7 @@ class UpgradeController:
                     .get_daemonset_controller_revision_hash
                 ),
                 hbm_floor_fraction=config.hbm_floor_fraction,
+                generation_floors=config.generation_floors,
             )
         )
         self.ds_reconciler = (

@@ -1,0 +1,167 @@
+"""Per-generation hardware profiles: the registry above ``hw.ChipSpec``.
+
+``hw.ChipSpec`` answers "what is one chip's published peak"; a
+:class:`GenerationProfile` answers the fleet-level questions the operator
+asks about a *generation*: how many chips share a host, what the ICI
+fabric should sustain, where the health-probe floors sit, how much power
+the generation burns per unit of work (the retirement-ordering weight),
+and whether the capacity class is preemptible.
+
+Probe floors live here — not as global constants — so a v5e pool is not
+judged against v5p bandwidth and vice versa.  The fused probe battery
+already isolates compile caches per ``device_kind`` (health.fused
+``BatteryKey``); this registry gives the same key a place to resolve
+thresholds from.
+
+Resolution accepts anything ``hw.chip_spec`` accepts: a
+``jax.Device.device_kind`` string (``"TPU v5 lite"``) or a GKE
+accelerator label (``"tpu-v5-lite-podslice"``).  Unknown kinds resolve
+to None and callers skip generation-relative behavior, same contract as
+``chip_spec``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_operator_libs_tpu.hw import ChipSpec, chip_spec
+
+# Default floor fractions, applied to the chip's published peak when a
+# profile does not pin explicit values: sustained readings below half of
+# spec on hardware that enumerates fine are exactly the
+# silent-degradation mode the probes exist to catch (hw.py rationale),
+# and ICI floors are more conservative because collective bus bandwidth
+# degrades with real topology/congestion long before the links are sick.
+HBM_FLOOR_FRACTION = 0.5
+MXU_FLOOR_FRACTION = 0.5
+ICI_FLOOR_FRACTION = 0.25
+
+
+@dataclass(frozen=True)
+class GenerationProfile:
+    """One TPU generation's fleet-level operating envelope.
+
+    ``order`` is the generation's age rank (lower = older hardware) and
+    drives oldest-first canary ordering; ``watts_per_chip`` is the
+    approximate board power used as the efficiency weight (watt-hungry
+    generations' downtime is retired first among equals).  Both are
+    scheduling inputs, not billing figures.
+    """
+
+    name: str
+    chip: ChipSpec
+    # Hosts of the standard podslice machine shape.
+    chips_per_host: int
+    # Aggregate per-chip ICI bandwidth the fabric should sustain, GB/s
+    # (published interconnect figures, one-way aggregate per chip).
+    ici_gbps: float
+    # Approximate board power per chip, watts (efficiency weight).
+    watts_per_chip: float
+    # Generation age rank for canary ordering (lower = older).
+    order: int
+    # Whether this generation is commonly run as preemptible/spot
+    # capacity; advisory metadata surfaced in status — the preemption
+    # *signal* on a node is always authoritative regardless.
+    preemptible: bool = False
+    # Per-generation probe thresholds.  0.0 = derive from the chip spec
+    # with the default fractions at resolve time.
+    mxu_tflops_floor: float = 0.0
+    hbm_gbps_floor: float = 0.0
+    ici_busbw_floor_gbps: float = 0.0
+    # Ceiling on a small all-reduce's latency, milliseconds; generous
+    # defaults — the probe exists to catch order-of-magnitude stalls
+    # (a wedged ICI retransmit path), not to benchmark the fabric.
+    allreduce_latency_ceiling_ms: float = field(default=2000.0)
+
+    def hbm_floor(self, fraction: float = 0.0) -> float:
+        """Effective HBM bandwidth floor, GB/s.  An explicit ``fraction``
+        (the policy-configured knob) wins; else the profile's pinned
+        floor; else the default fraction of chip spec."""
+        if fraction:
+            return fraction * self.chip.hbm_gbps
+        if self.hbm_gbps_floor:
+            return self.hbm_gbps_floor
+        return HBM_FLOOR_FRACTION * self.chip.hbm_gbps
+
+    def mxu_floor(self) -> float:
+        """MXU matmul throughput floor, TFLOPs."""
+        if self.mxu_tflops_floor:
+            return self.mxu_tflops_floor
+        return MXU_FLOOR_FRACTION * self.chip.bf16_tflops
+
+    def ici_floor(self) -> float:
+        """ICI all-reduce bus-bandwidth floor, GB/s."""
+        if self.ici_busbw_floor_gbps:
+            return self.ici_busbw_floor_gbps
+        return ICI_FLOOR_FRACTION * self.ici_gbps
+
+
+# Canonical generation name (ChipSpec.name) -> profile.  ICI figures are
+# the published aggregate interconnect bandwidths (v4 2400 Gbps/chip,
+# v5e 1600, v5p 4800, v6e 3584 — converted to GB/s); power figures are
+# approximate public board numbers, used only as relative weights.
+_BUILTIN_PROFILES: tuple[GenerationProfile, ...] = (
+    GenerationProfile(
+        name="v2", chip=chip_spec("tpu v2"), chips_per_host=4,
+        ici_gbps=62.0, watts_per_chip=280.0, order=2,
+    ),
+    GenerationProfile(
+        name="v3", chip=chip_spec("tpu v3"), chips_per_host=4,
+        ici_gbps=112.0, watts_per_chip=220.0, order=3,
+    ),
+    GenerationProfile(
+        name="v4", chip=chip_spec("tpu v4"), chips_per_host=4,
+        ici_gbps=300.0, watts_per_chip=192.0, order=4,
+    ),
+    GenerationProfile(
+        name="v5e", chip=chip_spec("tpu v5e"), chips_per_host=4,
+        ici_gbps=200.0, watts_per_chip=130.0, order=5,
+        preemptible=True,
+    ),
+    GenerationProfile(
+        name="v5p", chip=chip_spec("tpu v5p"), chips_per_host=4,
+        ici_gbps=600.0, watts_per_chip=350.0, order=6,
+    ),
+    GenerationProfile(
+        name="v6e", chip=chip_spec("tpu v6e"), chips_per_host=4,
+        ici_gbps=448.0, watts_per_chip=170.0, order=7,
+        preemptible=True,
+    ),
+)
+
+_LOCK = threading.Lock()
+_PROFILES: dict[str, GenerationProfile] = {
+    p.name: p for p in _BUILTIN_PROFILES
+}
+
+
+def register_generation(profile: GenerationProfile) -> None:
+    """Add (or replace) a generation profile — the extensibility hook for
+    generations this table predates.  The profile's ``chip.name`` should
+    match ``profile.name`` so ``chip_spec`` resolution finds it."""
+    with _LOCK:
+        _PROFILES[profile.name] = profile
+
+
+def known_generations() -> list[GenerationProfile]:
+    """All registered profiles, oldest generation first."""
+    with _LOCK:
+        return sorted(_PROFILES.values(), key=lambda p: (p.order, p.name))
+
+
+def generation_profile(device_kind: str) -> Optional[GenerationProfile]:
+    """Profile for a device-kind string or GKE accelerator label, or None
+    when the generation is unknown (CPU test meshes)."""
+    spec = chip_spec(device_kind)
+    if spec is None:
+        return None
+    with _LOCK:
+        return _PROFILES.get(spec.name)
+
+
+def generation_of(device_kind: str) -> str:
+    """Canonical generation name ("v5e"), or "" when unknown."""
+    profile = generation_profile(device_kind)
+    return profile.name if profile is not None else ""

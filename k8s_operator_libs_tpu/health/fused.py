@@ -60,6 +60,7 @@ from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.health.probes import (
     CheckResult,
     ICI_AXIS,
+    resolve_floors,
     shard_map,
 )
 
@@ -379,6 +380,18 @@ def run_fused_battery(
         "battery_compile_ms": 0.0 if cache_hit else battery.compile_ms,
         "battery_execute_ms": execute_ms,
     }
+    # Per-generation gate metadata (fleet GenerationProfile registry):
+    # the fused battery verifies correctness without sustained figures,
+    # so the floors this generation WOULD be judged against ride along
+    # in the metrics — observability plus downstream gating without a
+    # second registry lookup.  Mixed/unknown device kinds resolve to
+    # None and the checks carry no floor keys, same missing-figure
+    # convention as the throughput numbers themselves.
+    floors = resolve_floors(key.device_kind)
+    if floors is not None:
+        battery_metrics["floor_mxu_tflops"] = floors.mxu_tflops
+        battery_metrics["floor_hbm_gbps"] = floors.hbm_gbps
+        battery_metrics["floor_ici_busbw_gbps"] = floors.ici_busbw_gbps
 
     def result(
         name: str, ok: bool, detail: str, extra: Optional[dict] = None

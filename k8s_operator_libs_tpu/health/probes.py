@@ -85,6 +85,38 @@ class CheckResult:
         )
 
 
+@dataclass(frozen=True)
+class GenerationFloors:
+    """The probe gates one generation is judged against — resolved from
+    the fleet ``GenerationProfile`` registry, never from global
+    constants, so a v5e host is not held to v5p bandwidth."""
+
+    generation: str
+    mxu_tflops: float
+    hbm_gbps: float
+    ici_busbw_gbps: float
+    allreduce_latency_ms: float
+
+
+def resolve_floors(device_kind: str) -> Optional[GenerationFloors]:
+    """Per-generation probe floors for a device-kind string or GKE
+    accelerator label; None when the generation is unknown (CPU test
+    meshes) — callers then skip floor gating, same contract as
+    ``hw.chip_spec``."""
+    from k8s_operator_libs_tpu.fleet.profiles import generation_profile
+
+    profile = generation_profile(device_kind)
+    if profile is None:
+        return None
+    return GenerationFloors(
+        generation=profile.name,
+        mxu_tflops=profile.mxu_floor(),
+        hbm_gbps=profile.hbm_floor(),
+        ici_busbw_gbps=profile.ici_floor(),
+        allreduce_latency_ms=profile.allreduce_latency_ceiling_ms,
+    )
+
+
 def _timed(fn, *args) -> tuple[float, object]:
     """Run ``fn`` once for compile warmup, then time one synchronous call."""
     out = fn(*args)

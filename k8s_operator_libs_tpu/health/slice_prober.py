@@ -116,6 +116,12 @@ class NodeReportProber:
         # so the silent-HBM-degradation mode the probe exists to catch
         # actually gates.  Unknown accelerators leave the floor off.
         hbm_floor_fraction: float = 0.0,
+        # Resolve HBM/ICI floors from the fleet GenerationProfile
+        # registry when no explicit value is configured — so a v5e pool
+        # is gated at v5e spec, a v5p pool at v5p spec, from the same
+        # policy.  Off by default (reference behavior: unset floor =
+        # floor disabled).
+        generation_floors: bool = False,
     ) -> None:
         self.keys = keys
         self.max_report_age_s = max_report_age_s
@@ -123,6 +129,7 @@ class NodeReportProber:
         self.min_hbm_gbps = min_hbm_gbps
         self.min_ici_busbw_gbps = min_ici_busbw_gbps
         self.hbm_floor_fraction = hbm_floor_fraction
+        self.generation_floors = generation_floors
         # Require a DCN check (dcn_collective — the cross-slice XLA
         # all-reduce — or the TCP dcn_reachability fallback) in every
         # report for groups that belong to a DCN (multi-slice) group.
@@ -141,23 +148,43 @@ class NodeReportProber:
                 return self.revision_resolver(member.driver_daemon_set) or ""
         return ""
 
-    def _hbm_floor(self, group: UpgradeGroup) -> float:
-        """Effective HBM floor for this group: explicit wins; else derive
-        from the slice accelerator's published spec."""
-        if self.min_hbm_gbps or not self.hbm_floor_fraction:
-            return self.min_hbm_gbps
+    def _group_profile(self, group: UpgradeGroup):
+        """The group's GenerationProfile, or None (CPU test meshes)."""
         if group.slice_info is None:
-            return 0.0
-        from k8s_operator_libs_tpu.hw import chip_spec
+            return None
+        from k8s_operator_libs_tpu.fleet.profiles import generation_profile
 
-        spec = chip_spec(group.slice_info.accelerator)
-        if spec is None:
+        return generation_profile(group.slice_info.accelerator)
+
+    def _hbm_floor(self, group: UpgradeGroup) -> float:
+        """Effective HBM floor for this group: explicit wins; else the
+        policy fraction (or the profile's own floor under
+        ``generation_floors``) of the generation's published spec."""
+        if self.min_hbm_gbps:
+            return self.min_hbm_gbps
+        if not self.hbm_floor_fraction and not self.generation_floors:
             return 0.0
-        return self.hbm_floor_fraction * spec.hbm_gbps
+        profile = self._group_profile(group)
+        if profile is None:
+            return 0.0
+        if self.hbm_floor_fraction:
+            return profile.hbm_floor(self.hbm_floor_fraction)
+        return profile.hbm_floor()
+
+    def _ici_floor(self, group: UpgradeGroup) -> float:
+        """Effective ICI bus-bandwidth floor: explicit wins; else the
+        generation's profile floor under ``generation_floors``."""
+        if self.min_ici_busbw_gbps or not self.generation_floors:
+            return self.min_ici_busbw_gbps
+        profile = self._group_profile(group)
+        if profile is None:
+            return 0.0
+        return profile.ici_floor()
 
     def _check_report(
         self, report: HealthReport, group: UpgradeGroup, required_rev: str,
         now: float, hbm_floor: float = 0.0,
+        ici_floor: Optional[float] = None,
     ) -> Optional[str]:
         """Return a rejection reason, or None if the report is acceptable.
 
@@ -168,6 +195,8 @@ class NodeReportProber:
         exclusive device lock stops the agent from probing, so demanding
         continued freshness would time out every pipelined gate on real
         multi-host slices (the device-contention constraint)."""
+        if ici_floor is None:
+            ici_floor = self.min_ici_busbw_gbps
         if required_rev and report.driver_revision != required_rev:
             return (
                 f"report is for driver revision "
@@ -229,15 +258,15 @@ class NodeReportProber:
                     f"GB/s below floor {hbm_floor:.1f}"
                 )
             if (
-                self.min_ici_busbw_gbps
+                ici_floor
                 and check.name == "ici_allreduce"
                 and "busbw_gbps" in check.metrics
-                and check.metrics["busbw_gbps"] < self.min_ici_busbw_gbps
+                and check.metrics["busbw_gbps"] < ici_floor
             ):
                 return (
                     f"ICI bus bandwidth "
                     f"{check.metrics['busbw_gbps']:.1f} GB/s below "
-                    f"floor {self.min_ici_busbw_gbps:.1f}"
+                    f"floor {ici_floor:.1f}"
                 )
         return None
 
@@ -247,6 +276,7 @@ class NodeReportProber:
         required_rev = self._required_revision(group)
         now = time.time()
         hbm_floor = self._hbm_floor(group)
+        ici_floor = self._ici_floor(group)
         for node in group.nodes:
             raw = node.annotations.get(key)
             if not raw:
@@ -263,7 +293,7 @@ class NodeReportProber:
             raw_start = node.annotations.get(start_key, "")
             ref = min(now, float(raw_start)) if raw_start.isdigit() else now
             reason = self._check_report(
-                report, group, required_rev, ref, hbm_floor
+                report, group, required_rev, ref, hbm_floor, ici_floor
             )
             if reason is not None:
                 return ProbeResult(False, f"node {node.name}: {reason}")

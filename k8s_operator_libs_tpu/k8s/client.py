@@ -555,6 +555,33 @@ class FakeCluster:
             self._nodes.put(name, node)
             return deep_copy(node)
 
+    def patch_node_metadata(
+        self,
+        name: str,
+        labels: Optional[dict[str, Optional[str]]] = None,
+        annotations: Optional[dict[str, Optional[str]]] = None,
+    ) -> Node:
+        """Combined labels+annotations merge patch: ONE API call (one
+        stats tick), atomic under the store lock — the coalesced write
+        path batched slice transitions ride."""
+        self._call("patch_node")
+        with self._lock:
+            node = self._nodes.get_live(name)
+            if node is None:
+                raise NotFoundError(f"node {name}")
+            for k, v in (labels or {}).items():
+                if v is None:
+                    node.metadata.labels.pop(k, None)
+                else:
+                    node.metadata.labels[k] = v
+            for k, v in (annotations or {}).items():
+                if v is None:
+                    node.metadata.annotations.pop(k, None)
+                else:
+                    node.metadata.annotations[k] = v
+            self._nodes.put(name, node)
+            return deep_copy(node)
+
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
         self._call("patch_node")
         with self._lock:
@@ -640,6 +667,26 @@ class FakeCluster:
                     for name in list(self._nodes.objs):
                         if fault.target in name:
                             self._delete_node_locked(name)
+                elif fault.kind == "node_preempt":
+                    # amount >= 1: preempt (stamp + NotReady);
+                    # amount == 0: the node returns (clear + Ready).
+                    from k8s_operator_libs_tpu.upgrade.consts import (
+                        NODE_PREEMPTION_ANNOTATION,
+                    )
+
+                    preempted = fault.amount >= 1
+                    for name in list(self._nodes.objs):
+                        if fault.target in name:
+                            node = self._nodes.objs[name]
+                            if preempted:
+                                node.metadata.annotations[
+                                    NODE_PREEMPTION_ANNOTATION
+                                ] = str(int(time.time()))
+                            else:
+                                node.metadata.annotations.pop(
+                                    NODE_PREEMPTION_ANNOTATION, None
+                                )
+                            self._set_node_ready_locked(node, not preempted)
                 elif fault.kind == "pod_stick":
                     for key in list(self._pods.objs):
                         if fault.target in key[1]:

@@ -51,6 +51,7 @@ _DATA_PLANE_KINDS = (
     "node_down",
     "node_flap",
     "node_delete",
+    "node_preempt",
     "pod_stick",
     "pod_crashloop",
 )
@@ -230,6 +231,22 @@ class FaultSchedule:
         """Delete matching nodes outright (hardware reclaimed mid-roll)."""
         return self.add(
             FaultRule(match=match, kind="node_delete", target=target, **kw)
+        )
+
+    def node_preempt(
+        self, target: str, match: str = "", amount: int = 1, **kw
+    ) -> "FaultSchedule":
+        """Preempt matching nodes: stamp the platform preemption
+        annotation and take them NotReady — the spot-VM reclaim signal
+        the preemptible fast path handles without quarantine.
+        ``amount=0`` instead RETURNS the node (clears the annotation,
+        restores readiness), so one schedule can script the full
+        preempt/return cycle."""
+        return self.add(
+            FaultRule(
+                match=match, kind="node_preempt", target=target,
+                amount=amount, **kw,
+            )
         )
 
     def pod_stick(

@@ -758,6 +758,18 @@ class CachedKubeClient:
     ) -> Node:
         return self._echo(self._client.patch_node_annotations(name, patch))
 
+    def patch_node_metadata(
+        self,
+        name: str,
+        labels: Optional[dict[str, Optional[str]]] = None,
+        annotations: Optional[dict[str, Optional[str]]] = None,
+    ) -> Node:
+        return self._echo(
+            self._client.patch_node_metadata(
+                name, labels=labels, annotations=annotations
+            )
+        )
+
     def set_node_unschedulable(
         self, name: str, unschedulable: bool
     ) -> Node:

@@ -73,6 +73,18 @@ class KubeClient(Protocol):
         """Merge patch of ``metadata.annotations`` (None deletes)."""
         ...
 
+    def patch_node_metadata(
+        self,
+        name: str,
+        labels: Optional[dict[str, Optional[str]]] = None,
+        annotations: Optional[dict[str, Optional[str]]] = None,
+    ) -> Node:
+        """Combined labels+annotations patch in ONE API round trip (None
+        values delete).  The write-coalescing fast path: a slice
+        transition that flips the state label and stamps several durable
+        clocks costs one patch per node instead of one per key-group."""
+        ...
+
     def set_node_unschedulable(
         self, name: str, unschedulable: bool
     ) -> Node:

@@ -31,7 +31,7 @@ import time
 import urllib.parse
 from collections import Counter
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import (
@@ -853,6 +853,30 @@ class RestClient:
                 f"/api/v1/nodes/{name}",
                 body={"metadata": {"annotations": patch}},
                 content_type=MERGE_PATCH,
+            )
+        )
+
+    def patch_node_metadata(
+        self,
+        name: str,
+        labels: Optional[dict[str, Optional[str]]] = None,
+        annotations: Optional[dict[str, Optional[str]]] = None,
+    ) -> Node:
+        # One PATCH carrying both maps; strategic-merge and JSON-merge
+        # coincide for flat string maps (null deletes), and the server's
+        # node patch handler applies labels and annotations from a single
+        # body (apiserver._patch_node).
+        meta: dict[str, Any] = {}
+        if labels:
+            meta["labels"] = labels
+        if annotations:
+            meta["annotations"] = annotations
+        return node_from_json(
+            self._request(
+                "PATCH",
+                f"/api/v1/nodes/{name}",
+                body={"metadata": meta},
+                content_type=STRATEGIC_MERGE_PATCH,
             )
         )
 

@@ -193,6 +193,12 @@ class UpgradeMetrics:
             "(all verbs; ~0 at steady state with a warm cache)",
         )
         r.describe(
+            "api_writes_per_tick",
+            "Mutating API round trips (patch/create/delete/evict/update) "
+            "issued during the last reconcile pass — the write-path "
+            "hygiene number the coalesced node patches drive down",
+        )
+        r.describe(
             "informer_cache_hits_total",
             "Hot-path reads served from the informer store",
         )
@@ -350,9 +356,34 @@ class UpgradeMetrics:
             "(stamp -> healthy verdict, including async probe queueing)",
             "slice",
         )
+        # Heterogeneous-fleet surface.
+        r.describe(
+            "preemptions_total",
+            "Preempted in-flight slices observed, per generation "
+            "(fast-path handling: no quarantine, budget released)",
+            "generation",
+        )
+        r.describe(
+            "fleet_nodes",
+            "Managed nodes per device generation",
+            "generation",
+        )
+        r.describe(
+            "fleet_pool_window_open",
+            "1 when the pool's maintenance window is open (or it has "
+            "none), 0 while its groups hold in window-wait",
+            "pool",
+        )
+        r.describe(
+            "fleet_window_held_groups",
+            "Groups currently holding in the budget-free window-wait "
+            "condition",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
+        # api_writes_per_tick baseline, write verbs only.
+        self._last_api_writes: Optional[float] = None
 
     def observe(self, manager, state, duration_s: float) -> None:
         r = self.registry
@@ -443,6 +474,65 @@ class UpgradeMetrics:
                     "api_requests_per_tick", total - self._last_api_total
                 )
             self._last_api_total = total
+            # Write verbs only.  Stats keys are "patch_node" style on the
+            # fake cluster and "PATCH nodes" style on the REST client, so
+            # a case-insensitive prefix match covers both.
+            writes = float(
+                sum(
+                    v
+                    for k, v in api_stats.items()
+                    if str(k)
+                    .lower()
+                    .startswith(
+                        (
+                            "patch",
+                            "create",
+                            "delete",
+                            "evict",
+                            "update",
+                            "post",
+                            "put",
+                        )
+                    )
+                )
+            )
+            if self._last_api_writes is not None:
+                r.set("api_writes_per_tick", writes - self._last_api_writes)
+            self._last_api_writes = writes
+        # Heterogeneous-fleet surface.
+        preemptions = getattr(manager, "preemptions", None)
+        if preemptions is not None:
+            for gen, count in sorted(preemptions.items()):
+                r.set("preemptions_total", count, generation=gen or "unknown")
+        try:
+            from k8s_operator_libs_tpu.fleet.profiles import generation_of
+        except Exception:  # noqa: BLE001 — keep metrics best-effort
+            generation_of = None
+        if generation_of is not None:
+            gen_nodes: dict = {}
+            for groups in state.groups.values():
+                for group in groups:
+                    accel = getattr(
+                        getattr(group, "slice_info", None), "accelerator", ""
+                    )
+                    gen = generation_of(accel or "") or "unknown"
+                    gen_nodes[gen] = gen_nodes.get(gen, 0) + group.size()
+            r.clear("fleet_nodes")
+            for gen, count in sorted(gen_nodes.items()):
+                r.set("fleet_nodes", count, generation=gen)
+        window_open = getattr(manager, "pool_window_open", None)
+        if window_open is not None:
+            r.clear("fleet_pool_window_open")
+            for pool, is_open in sorted(window_open.items()):
+                r.set(
+                    "fleet_pool_window_open",
+                    1 if is_open else 0,
+                    pool=pool,
+                )
+        r.set(
+            "fleet_window_held_groups",
+            getattr(manager, "window_held_groups", 0),
+        )
         # Fused-battery surface: import lazily so a controller built
         # without jax (pure NodeReportProber aggregation) still exports
         # everything else.

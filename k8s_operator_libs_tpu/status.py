@@ -326,6 +326,36 @@ def gather(
                 "members": member_states,
             }
         )
+    # Heterogeneous-fleet view: hosts per TPU generation, currently
+    # preempted hosts, and pools holding for a maintenance window —
+    # read from the durable annotations the engine stamps, so the
+    # section is correct even with no controller running.
+    from k8s_operator_libs_tpu.fleet.profiles import generation_of
+    from k8s_operator_libs_tpu.upgrade.consts import (
+        NODE_PREEMPTION_ANNOTATION,
+    )
+
+    generations: dict[str, dict] = {}
+    window_holds: dict[str, int] = {}
+    window_key = keys.window_wait_annotation
+    for group in state.all_groups():
+        accel = group.slice_info.accelerator if group.slice_info else ""
+        gen = generation_of(accel) or "unknown"
+        row = generations.setdefault(
+            gen, {"nodes": 0, "groups": 0, "preempted": 0}
+        )
+        row["nodes"] += group.size()
+        row["groups"] += 1
+        row["preempted"] += sum(
+            1
+            for m in group.members
+            if NODE_PREEMPTION_ANNOTATION in m.node.annotations
+        )
+        for m in group.members:
+            pool = m.node.annotations.get(window_key, "")
+            if pool:
+                window_holds[pool] = window_holds.get(pool, 0) + 1
+                break
     out = {
         "totalManagedNodes": mgr.get_total_managed_nodes(state),
         "totalManagedGroups": mgr.get_total_managed_groups(state),
@@ -339,6 +369,11 @@ def gather(
         "evictionEscalationsInFlight": escalations_in_flight,
         "groups": groups,
     }
+    if generations:
+        fleet_section: dict = {"generations": generations}
+        if window_holds:
+            fleet_section["windowHolds"] = window_holds
+        out["fleet"] = fleet_section
     if policy_section is not None:
         out["policy"] = policy_section
     # Control-plane health: when the client carries a circuit breaker
@@ -460,6 +495,29 @@ def render(status: dict) -> str:
             "quarantine cycles: "
             + ", ".join(f"{gid}={n}" for gid, n in sorted(cycles.items()))
         )
+    fleet = status.get("fleet")
+    if fleet is not None:
+        lines.append("")
+        lines.append("fleet by generation:")
+        for gen, row in sorted((fleet.get("generations") or {}).items()):
+            extra = (
+                f", {int(row.get('preempted', 0))} preempted"
+                if row.get("preempted")
+                else ""
+            )
+            lines.append(
+                f"  {gen:10s} {int(row['nodes']):>4d} host(s) in "
+                f"{int(row['groups'])} group(s){extra}"
+            )
+        holds = fleet.get("windowHolds") or {}
+        if holds:
+            lines.append(
+                "maintenance-window holds: "
+                + ", ".join(
+                    f"{pool}={int(n)} group(s)"
+                    for pool, n in sorted(holds.items())
+                )
+            )
     leader = status.get("leader")
     if leader is not None:
         lines.append("")

@@ -296,6 +296,31 @@ UPGRADE_ELASTIC_REJOIN_COMPLETE_ANNOTATION_KEY_FMT = (
 ELASTIC_RESPONSE_ACCEPT = "accept"
 ELASTIC_RESPONSE_DECLINE = "decline"
 
+# --- heterogeneous fleet: preemption + maintenance windows -----------------
+# Platform preemption signal: stamped on a node by the infrastructure (on
+# GKE a spot/preemptible VM gets a termination notice; the fake tier's
+# node_preempt fault stamps the same key).  A FIXED key, not
+# driver-scoped: preemption is a property of the machine, not of any one
+# managed driver.  Presence = the node is preempted/being reclaimed.
+NODE_PREEMPTION_ANNOTATION = "tpu.google.com/node-preempted"
+# Engine-side bookkeeping stamped on a preempted in-flight group: epoch
+# seconds when the controller first observed the preemption.  Its
+# presence records that the budget claim was already released and the
+# preemption counted (idempotent across passes and controller crashes);
+# cleared at re-admission.  Unlike quarantine there is NO prior-state
+# annotation and NO dwell clock: the state label never changes while the
+# node is gone, and return re-admits on the first all-Ready pass.
+UPGRADE_PREEMPTED_SINCE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-preempted-since"
+)
+# Condition marker for a pool held outside its maintenance window: the
+# value is the pool name.  A CONDITION, not a state — the state label is
+# untouched, the group makes zero transitions and holds zero budget
+# while marked; cleared on the first pass inside the window.
+UPGRADE_WINDOW_WAIT_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-window-wait"
+)
+
 # --- durable in-flight progress clocks -------------------------------------
 # Every escalation/backoff decision the controller makes mid-roll is
 # externalized into node annotations through the same idempotent patch

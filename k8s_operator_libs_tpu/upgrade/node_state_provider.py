@@ -18,6 +18,8 @@ label flip costs one round-trip + one cache-sync wait, not N.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -41,6 +43,22 @@ logger = get_logger(__name__)
 
 class CacheSyncTimeout(RuntimeError):
     """The written value never became visible in the read cache."""
+
+
+class _WriteBatch:
+    """Per-node pending label/annotation patches for one coalesced flush.
+
+    Values are PATCH values: None = delete.  ``nodes`` keeps the caller's
+    Node object per name so the flush's visibility wait can refresh it.
+    """
+
+    def __init__(self) -> None:
+        self.labels: dict[str, dict[str, Optional[str]]] = {}
+        self.annotations: dict[str, dict[str, Optional[str]]] = {}
+        self.nodes: dict[str, Node] = {}
+
+    def names(self) -> list[str]:
+        return sorted(set(self.labels) | set(self.annotations))
 
 
 def node_ready(node: Node) -> bool:
@@ -83,6 +101,118 @@ class NodeUpgradeStateProvider:
         # convergence polls and the whole point is to read the cache.
         self.max_staleness_s = max_staleness_s
         self._node_mutex = KeyedMutex()
+        # Active write-coalescing batch, per thread: the engine's pass
+        # thread batches while drain/probe workers keep writing through
+        # directly.
+        self._batch_local = threading.local()
+
+    # -- write coalescing ----------------------------------------------------
+
+    def _active_batch(self) -> Optional[_WriteBatch]:
+        return getattr(self._batch_local, "batch", None)
+
+    @contextlib.contextmanager
+    def batched(self):
+        """Coalesce this thread's node writes into one patch per node.
+
+        Inside the context, ``change_node(s)_upgrade_state`` /
+        ``change_node(s)_upgrade_annotation`` apply their mutation to the
+        caller's Node objects immediately (read-your-writes within the
+        pass) and defer the API write; on exit every node gets a single
+        combined labels+annotations patch (``patch_node_metadata``) and
+        one cache-sync wait.  A transition that today costs a label
+        patch plus N annotation round trips per node collapses to one.
+
+        Nested use joins the outer batch.  The batch is thread-local, so
+        concurrently-running workers are unaffected.
+        """
+        if self._active_batch() is not None:
+            yield self
+            return
+        batch = _WriteBatch()
+        self._batch_local.batch = batch
+        try:
+            yield self
+        finally:
+            self._batch_local.batch = None
+        self._flush_batch(batch)
+
+    def _flush_batch(self, batch: _WriteBatch) -> None:
+        names = batch.names()
+        if not names:
+            return
+        run_batch(
+            [(lambda n=n: self._flush_node(batch, n)) for n in names],
+            self.max_concurrency,
+        )
+
+    def _flush_node(self, batch: _WriteBatch, name: str) -> None:
+        labels = batch.labels.get(name)
+        annotations = batch.annotations.get(name)
+        with self._node_mutex.lock(name):
+            try:
+                combined = getattr(self.client, "patch_node_metadata", None)
+                if combined is not None:
+                    combined(name, labels=labels, annotations=annotations)
+                else:  # client predates the combined patch: two writes
+                    if labels:
+                        self.client.patch_node_labels(name, labels)
+                    if annotations:
+                        self.client.patch_node_annotations(name, annotations)
+            except Exception:
+                log_event(
+                    self.event_recorder,
+                    name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    "Failed to apply coalesced node metadata patch",
+                )
+                raise
+            self._wait_metadata_visible(
+                batch.nodes[name], labels or {}, annotations or {}
+            )
+
+    def _wait_metadata_visible(
+        self,
+        node: Node,
+        labels: dict[str, Optional[str]],
+        annotations: dict[str, Optional[str]],
+    ) -> None:
+        """Poll the read cache until every batched key shows its patched
+        value (None = absent) — the same write-then-poll contract as the
+        single-key waits, amortized over the whole patch."""
+        deadline = time.monotonic() + self.poll_timeout_s
+        while True:
+            try:
+                fresh = self.client.get_node(node.name, cached=True)
+            except NotFoundError:
+                fresh = None
+            if fresh is not None:
+                ok = all(
+                    fresh.labels.get(k) == v
+                    if v is not None
+                    else k not in fresh.labels
+                    for k, v in labels.items()
+                ) and all(
+                    fresh.annotations.get(k) == v
+                    if v is not None
+                    else k not in fresh.annotations
+                    for k, v in annotations.items()
+                )
+                if ok:
+                    node.metadata = fresh.metadata
+                    node.spec = fresh.spec
+                    node.status = fresh.status
+                    return
+            if time.monotonic() >= deadline:
+                raise CacheSyncTimeout(
+                    f"node {node.name}: coalesced patch "
+                    f"({len(labels)} labels, {len(annotations)} "
+                    f"annotations) not visible within {self.poll_timeout_s}s"
+                )
+            time.sleep(
+                min(self.poll_interval_s, max(0.0, deadline - time.monotonic()))
+            )
 
     # -- reads -------------------------------------------------------------
 
@@ -96,6 +226,18 @@ class NodeUpgradeStateProvider:
 
     def change_node_upgrade_state(self, node: Node, new_state: UpgradeState) -> None:
         """Patch the state label and wait until the cache shows it."""
+        batch = self._active_batch()
+        if batch is not None:
+            value = (
+                new_state.value if new_state != UpgradeState.UNKNOWN else None
+            )
+            batch.labels.setdefault(node.name, {})[self.keys.state_label] = value
+            batch.nodes[node.name] = node
+            if value is None:
+                node.metadata.labels.pop(self.keys.state_label, None)
+            else:
+                node.metadata.labels[self.keys.state_label] = value
+            return
         with self._node_mutex.lock(node.name):
             self._patch_state(node.name, new_state)
             self._wait_label_visible(node, self.keys.state_label, new_state.value)
@@ -105,8 +247,17 @@ class NodeUpgradeStateProvider:
     ) -> None:
         """Patch an annotation; ``value == "null"`` deletes it
         (node_upgrade_state_provider.go:147-150)."""
+        patch_value = None if value == NULL_STRING else value
+        batch = self._active_batch()
+        if batch is not None:
+            batch.annotations.setdefault(node.name, {})[key] = patch_value
+            batch.nodes[node.name] = node
+            if patch_value is None:
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = patch_value
+            return
         with self._node_mutex.lock(node.name):
-            patch_value = None if value == NULL_STRING else value
             self.client.patch_node_annotations(node.name, {key: patch_value})
             self._wait_annotation_visible(node, key, value)
 
@@ -121,6 +272,13 @@ class NodeUpgradeStateProvider:
         Raises on the first failure after all attempts complete, so a
         partially-written slice is re-driven by the next idempotent pass
         (the group's effective_state resolves to the earliest member)."""
+        if self._active_batch() is not None:
+            # The coalescing batch is thread-local: fanning out to worker
+            # threads would bypass it, so apply in-line (recording into a
+            # batch is cheap — the round trips happen at flush).
+            for n in nodes:
+                self.change_node_upgrade_state(n, new_state)
+            return
         run_batch(
             [
                 (lambda n=n: self.change_node_upgrade_state(n, new_state))
@@ -132,6 +290,10 @@ class NodeUpgradeStateProvider:
     def change_nodes_upgrade_annotation(
         self, nodes: Sequence[Node], key: str, value: str
     ) -> None:
+        if self._active_batch() is not None:
+            for n in nodes:
+                self.change_node_upgrade_annotation(n, key, value)
+            return
         run_batch(
             [
                 (lambda n=n: self.change_node_upgrade_annotation(n, key, value))

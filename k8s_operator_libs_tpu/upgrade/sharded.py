@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.fleet.scheduler import pool_sort_key
 from k8s_operator_libs_tpu.k8s.client import WatchEvent
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
@@ -102,15 +103,30 @@ class DirtySetQueue:
     def mark_many(self, keys) -> int:
         return sum(1 for k in keys if self.mark(k))
 
-    def take(self, max_n: Optional[int] = None) -> list[tuple[str, float]]:
+    def take(
+        self,
+        max_n: Optional[int] = None,
+        sort_key: Optional[Callable[[str], object]] = None,
+    ) -> list[tuple[str, float]]:
         """Claim up to ``max_n`` dirty pools (FIFO).  Returns
         ``(key, queued_for_seconds)`` pairs; each key stays in-flight
-        until ``done``."""
+        until ``done``.
+
+        ``sort_key`` overrides FIFO for *batch selection* — the
+        generation-aware scheduler passes one so oldest-generation pools
+        canary first when the queue holds more work than the batch
+        admits.  Coalescing and per-pool serialization are unaffected,
+        and a key skipped by the sort keeps its original mark time, so
+        queue-age metrics still expose any pool the sort perpetually
+        defers."""
         now = time.monotonic()
         with self._lock:
             n = len(self._dirty) if max_n is None else max_n
+            candidates = list(self._dirty)
+            if sort_key is not None:
+                candidates.sort(key=sort_key)
             batch: list[tuple[str, float]] = []
-            for key in list(self._dirty):
+            for key in candidates:
                 if len(batch) >= n:
                     break
                 marked_at = self._dirty.pop(key)
@@ -298,6 +314,16 @@ class BudgetLedger:
         # event-free instead of stalling until the next full resync.
         self._waiters: set[str] = set()
         self.on_release: Optional[Callable[[set[str]], None]] = None
+        # Per-pool budget hierarchy (heterogeneous fleets): pool name →
+        # (max_unavailable_units, max_parallel).  A claim must clear the
+        # fleet caps AND its pool's caps — fleet ∧ pool.  Empty = the
+        # classic single-pool behaviour.
+        self._pool_caps: dict[str, tuple[int, int]] = {}
+        # group_id → pool name, recorded at claim time.
+        self._pool_of_charge: dict[str, str] = {}
+        # group_id → pool name resolver supplied by the engine; lets
+        # callers omit the ``pool=`` argument on try_claim.
+        self.pool_resolver: Optional[Callable[[str], Optional[str]]] = None
 
     def configure(
         self,
@@ -312,7 +338,27 @@ class BudgetLedger:
             self.max_unavailable = max_unavailable
             self.unit = unit
 
+    def configure_pools(
+        self, pool_caps: dict[str, tuple[int, int]]
+    ) -> None:
+        """Install per-pool ``(max_unavailable_units, max_parallel)``
+        caps.  0 max_parallel = unlimited; a pool absent from the map is
+        only bounded by the fleet caps."""
+        with self._lock:
+            self._pool_caps = dict(pool_caps)
+
     # -- claims --------------------------------------------------------------
+
+    def _pool_usage(self, pool: str) -> tuple[int, int]:
+        """(unavailable units, parallel count) charged to ``pool``.
+        Caller holds the lock."""
+        used = 0
+        count = 0
+        for gid, p in self._pool_of_charge.items():
+            if p == pool:
+                used += self._charges.get(gid, 0)
+                count += 1
+        return used, count
 
     def _dcn_held_by_other(self, group_id: str, dcn_group: str) -> bool:
         return any(
@@ -326,17 +372,24 @@ class BudgetLedger:
         cost: int,
         dcn_group: Optional[str] = None,
         force: bool = False,
+        pool: Optional[str] = None,
     ) -> bool:
         """Atomically admit ``group_id`` at ``cost`` unavailability
         units.  ``force`` charges past the caps (an already-cordoned
         group is genuinely unavailable whether or not we admit it — the
         reference's bypass, upgrade_state.go:606-616) but still records
-        the charge so other claims see it."""
+        the charge so other claims see it.  ``pool`` scopes the claim to
+        a per-pool budget when the policy declares pools; omitted, the
+        installed ``pool_resolver`` is consulted."""
+        if pool is None and self.pool_resolver is not None:
+            pool = self.pool_resolver(group_id)
         with self._lock:
             if group_id in self._charges:
                 # Idempotent re-claim by the group's own pool.
                 if dcn_group is not None:
                     self._dcn_of[group_id] = dcn_group
+                if pool is not None:
+                    self._pool_of_charge[group_id] = pool
                 return True
             if not force:
                 denied = False
@@ -356,6 +409,18 @@ class BudgetLedger:
                     )
                     if used + cost > self.max_unavailable:
                         denied = True
+                if not denied and pool is not None:
+                    caps = self._pool_caps.get(pool)
+                    if caps is not None:
+                        pool_max_unavailable, pool_max_parallel = caps
+                        pool_used, pool_count = self._pool_usage(pool)
+                        if (
+                            pool_max_parallel > 0
+                            and pool_count >= pool_max_parallel
+                        ):
+                            denied = True
+                        elif pool_used + cost > pool_max_unavailable:
+                            denied = True
                 if denied:
                     self._waiters.add(group_id)
                     return False
@@ -363,6 +428,8 @@ class BudgetLedger:
             self._waiters.discard(group_id)
             if dcn_group is not None:
                 self._dcn_of[group_id] = dcn_group
+            if pool is not None:
+                self._pool_of_charge[group_id] = pool
             return True
 
     def release(self, group_id: str) -> None:
@@ -370,6 +437,7 @@ class BudgetLedger:
         with self._lock:
             had = self._charges.pop(group_id, None)
             self._dcn_of.pop(group_id, None)
+            self._pool_of_charge.pop(group_id, None)
             self._waiters.discard(group_id)
             if had is not None and self._waiters:
                 waiters, self._waiters = self._waiters, set()
@@ -392,6 +460,18 @@ class BudgetLedger:
         with self._lock:
             return group_id in self._charges
 
+    def pool_unavailable_used(self, pool: str) -> int:
+        with self._lock:
+            return self._pool_usage(pool)[0]
+
+    def pool_parallel_used(self, pool: str) -> int:
+        with self._lock:
+            return self._pool_usage(pool)[1]
+
+    def pool_caps(self) -> dict[str, tuple[int, int]]:
+        with self._lock:
+            return dict(self._pool_caps)
+
     def snapshot(self) -> LedgerSnapshot:
         with self._lock:
             return LedgerSnapshot(
@@ -401,6 +481,8 @@ class BudgetLedger:
                 max_unavailable=self.max_unavailable,
                 charges=dict(self._charges),
                 external_unavailable=self.external_unavailable,
+                pool_caps=dict(self._pool_caps),
+                pool_of_charge=dict(self._pool_of_charge),
             )
 
     def sync_from_state(self, manager, state, policy) -> None:
@@ -425,10 +507,43 @@ class BudgetLedger:
         # same-DCN groups the admission path deliberately allows.
         dcn_anti_affinity = bool(getattr(policy, "dcn_anti_affinity", False))
         pipeline = bool(getattr(policy, "pipeline_validation", False))
+        # Heterogeneous fleets: per-pool membership, per-pool caps.
+        pools = list(getattr(policy, "pools", None) or [])
+        pool_for_group = getattr(manager, "_pool_for_group", None)
+        budget_exempt = getattr(manager, "_group_budget_exempt", None)
+        pool_of: dict[str, str] = {}
+        pool_units: dict[str, int] = {}
+        if pools and pool_for_group is not None:
+            for group in state.all_groups():
+                pool_name = pool_for_group(group, policy)
+                if pool_name is None:
+                    continue
+                pool_of[group.id] = pool_name
+                pool_units[pool_name] = pool_units.get(pool_name, 0) + (
+                    1 if unit == "slice" else group.size()
+                )
+        pool_caps: dict[str, tuple[int, int]] = {}
+        for pool_spec in pools:
+            units_in_pool = pool_units.get(pool_spec.name, 0)
+            cap = units_in_pool  # no override: bounded by fleet caps only
+            if pool_spec.max_unavailable is not None:
+                cap = pool_spec.max_unavailable.scaled_value(
+                    units_in_pool, round_up=True
+                )
+            pool_caps[pool_spec.name] = (
+                cap,
+                pool_spec.max_parallel_upgrades or 0,
+            )
         charges: dict[str, int] = {}
         dcn_of: dict[str, str] = {}
+        pool_of_charge: dict[str, str] = {}
         for st in IN_PROGRESS_STATES:
             for group in state.groups_in(st):
+                if budget_exempt is not None and budget_exempt(group):
+                    # Preempted or window-held: the group holds no budget
+                    # while gone — re-charging at resync would undo the
+                    # fast-path release.
+                    continue
                 if (
                     pipeline
                     and st == UpgradeState.VALIDATION_REQUIRED
@@ -447,6 +562,8 @@ class BudgetLedger:
                     # release.
                     continue
                 charges[group.id] = 1 if unit == "slice" else group.size()
+                if group.id in pool_of:
+                    pool_of_charge[group.id] = pool_of[group.id]
                 if (
                     dcn_anti_affinity
                     and group.slice_info is not None
@@ -460,6 +577,8 @@ class BudgetLedger:
                 continue  # claimed above, or quarantine holds no budget
             if manager._group_elastic_excluded(group):
                 continue  # excluded-by-resize holds no budget either
+            if budget_exempt is not None and budget_exempt(group):
+                continue  # preempted / window-held holds no budget
             if unit == "slice":
                 if manager._group_unavailable(group):
                     external += 1
@@ -477,6 +596,8 @@ class BudgetLedger:
             self._charges = charges
             self._dcn_of = dcn_of
             self.external_unavailable = external
+            self._pool_caps = pool_caps
+            self._pool_of_charge = pool_of_charge
 
 
 @dataclass
@@ -539,6 +660,12 @@ class ShardedReconciler:
         self._outstanding: set[Future] = set()
         self.stats: Counter = Counter()
         self._seeded = False
+        # Generation-aware batch ordering: pool key → accelerator kind,
+        # remembered at full resync; oldest-generation pools canary
+        # first when a tick cannot drain the whole queue.
+        self._pool_accel: dict[str, str] = {}
+        # group id → policy pool name, for the ledger's per-pool caps.
+        self._group_pool: dict[str, str] = {}
 
     # -- feed ----------------------------------------------------------------
 
@@ -583,12 +710,31 @@ class ShardedReconciler:
         if started is None:
             started = time.monotonic()
         node_pool: dict[str, str] = {}
+        pool_accel: dict[str, str] = {}
+        group_pool: dict[str, str] = {}
+        pool_for_group = getattr(self.manager, "_pool_for_group", None)
+        has_policy_pools = bool(getattr(policy, "pools", None))
         for group in state.all_groups():
+            accel = (
+                group.slice_info.accelerator
+                if group.slice_info is not None
+                else ""
+            )
+            if has_policy_pools and pool_for_group is not None:
+                name = pool_for_group(group, policy)
+                if name is not None:
+                    group_pool[group.id] = name
             for member in group.members:
-                node_pool[member.node.name] = pool_key_for_node(
-                    member.node, self.manager.keys
-                )
+                key = pool_key_for_node(member.node, self.manager.keys)
+                node_pool[member.node.name] = key
+                if accel:
+                    pool_accel.setdefault(key, accel)
         self.router.seed(node_pool)
+        self._pool_accel = pool_accel
+        self._group_pool = group_pool
+        self.ledger.pool_resolver = (
+            self._group_pool.get if group_pool else None
+        )
         self.ledger.sync_from_state(self.manager, state, policy)
         self._seeded = True
         return started
@@ -621,7 +767,9 @@ class ShardedReconciler:
         the queue keeps accepting deltas for other pools."""
         t0 = time.monotonic()
         report = TickReport()
-        batch = self.queue.take(max_pools)
+        batch = self.queue.take(
+            max_pools, sort_key=pool_sort_key(self._pool_accel.get)
+        )
         if not batch:
             report.queue_depth_after = self.queue.depth()
             report.duration_s = time.monotonic() - t0

@@ -31,6 +31,9 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     TPUUpgradePolicySpec,
 )
 from k8s_operator_libs_tpu.consts import get_logger
+from k8s_operator_libs_tpu.fleet.profiles import generation_of
+from k8s_operator_libs_tpu.fleet.scheduler import group_sort_key
+from k8s_operator_libs_tpu.fleet.windows import window_open
 from k8s_operator_libs_tpu.k8s.client import NotFoundError
 from k8s_operator_libs_tpu.k8s.drain import (
     ALL_RUNGS,
@@ -44,6 +47,7 @@ from k8s_operator_libs_tpu.upgrade.consts import (
     ELASTIC_RESPONSE_ACCEPT,
     ELASTIC_RESPONSE_DECLINE,
     IN_PROGRESS_STATES,
+    NODE_PREEMPTION_ANNOTATION,
     QUARANTINABLE_STATES,
     TRUE_STRING,
     UpgradeState,
@@ -273,6 +277,12 @@ class ClusterUpgradeStateManager:
         # state-local arithmetic — scoped passes see one pool and would
         # otherwise jointly overspend maxUnavailable across shards.
         self.budget_ledger = None
+        # Heterogeneous-fleet (fleet/) bookkeeping: preemption fast-path
+        # counters per generation, plus maintenance-window visibility for
+        # metrics/status (pool name -> window currently open?).
+        self.preemptions: dict[str, int] = {}
+        self.pool_window_open: dict[str, bool] = {}
+        self.window_held_groups = 0
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -701,6 +711,47 @@ class ClusterUpgradeStateManager:
                 else None
             )
 
+        # Mixed pools in one CR need per-pool cap arbitration even on the
+        # unsharded path: build a pass-local ledger from this snapshot so
+        # admission goes through the same fleet ∧ pool claim the sharded
+        # reconciler uses.  Restored to None at the end of the pass — the
+        # next pass re-derives it from its own snapshot, so it needs no
+        # cross-pass consistency.
+        ephemeral_ledger = None
+        if self.budget_ledger is None and self._policy_pools(policy):
+            from k8s_operator_libs_tpu.upgrade.sharded import BudgetLedger
+
+            ephemeral_ledger = BudgetLedger()
+            pool_of = {
+                g.id: self._pool_for_group(g, policy)
+                for g in current_state.all_groups()
+            }
+            ephemeral_ledger.pool_resolver = pool_of.get
+            ephemeral_ledger.sync_from_state(self, current_state, policy)
+            self.budget_ledger = ephemeral_ledger
+        try:
+            self._apply_state_processors(
+                current_state, policy, scoped, validation_active, pipeline
+            )
+        finally:
+            if ephemeral_ledger is not None:
+                self.budget_ledger = None
+
+    def _apply_state_processors(
+        self,
+        current_state: ClusterUpgradeState,
+        policy: DriverUpgradePolicySpec,
+        scoped: bool,
+        validation_active: bool,
+        pipeline: bool,
+    ) -> None:
+        # Preemption fast-path and maintenance-window gating run FIRST:
+        # a preempted or window-held group must vanish from the snapshot
+        # before ANY processor (quarantine included) can act on it — zero
+        # transitions, zero budget held.
+        self.process_preemption(current_state, policy)
+        self.process_maintenance_windows(current_state, policy)
+
         # Slice quarantine runs BEFORE the slot math: a slice parked this
         # pass must already have released its unavailability budget when
         # upgrades_available is computed below, and a slice rejoining is
@@ -866,7 +917,13 @@ class ClusterUpgradeStateManager:
             isinstance(policy, TPUUpgradePolicySpec) and policy.dcn_anti_affinity
         )
         busy_dcn = self._in_flight_dcn_groups(state) if dcn_anti_affinity else set()
-        for group in state.groups_in(UpgradeState.UPGRADE_REQUIRED):
+        # Generation-aware ordering (fleet/scheduler): budget slots drain
+        # oldest-generation-first — the cheapest canary sees a new driver
+        # before the flagship pools do.  Deterministic and label-derived,
+        # so every controller incarnation computes the same order.
+        for group in sorted(
+            state.groups_in(UpgradeState.UPGRADE_REQUIRED), key=group_sort_key
+        ):
             requested = [
                 m.node
                 for m in group.members
@@ -1601,6 +1658,226 @@ class ClusterUpgradeStateManager:
             self._move_group_bucket(state, group, UpgradeState.DONE)
             logger.info("group %s rejoin-resize finished -> done", group.id)
 
+    # -- heterogeneous fleets (fleet/): pools, windows, preemption -----------
+
+    @staticmethod
+    def _policy_pools(policy) -> list:
+        if isinstance(policy, TPUUpgradePolicySpec):
+            return list(policy.pools or [])
+        return []
+
+    def _pool_for_group(self, group: UpgradeGroup, policy) -> Optional[str]:
+        """The policy pool this group belongs to: first pool (in CR list
+        order) whose node_selector fully matches the group's first
+        member's labels.  Slice members share node-pool labels by
+        construction, so one member decides for the group; first-match
+        order makes membership deterministic when selectors overlap."""
+        pools = self._policy_pools(policy)
+        if not pools or not group.members:
+            return None
+        labels = group.members[0].node.labels
+        for pool in pools:
+            selector = pool.node_selector
+            if selector and all(
+                labels.get(k) == v for k, v in selector.items()
+            ):
+                return pool.name
+        return None
+
+    def _group_preempted(self, group: UpgradeGroup) -> bool:
+        """Any member carries the platform preemption signal."""
+        return any(
+            NODE_PREEMPTION_ANNOTATION in m.node.annotations
+            for m in group.members
+        )
+
+    def _group_window_held(self, group: UpgradeGroup) -> bool:
+        """The group is holding in the window-wait condition."""
+        key = self.keys.window_wait_annotation
+        return any(key in m.node.annotations for m in group.members)
+
+    def _group_budget_exempt(self, group: UpgradeGroup) -> bool:
+        """Preempted and window-held groups hold no budget — the hook
+        BudgetLedger.sync_from_state consults so a full resync does not
+        silently re-charge what the fast paths released."""
+        return self._group_preempted(group) or self._group_window_held(group)
+
+    def _remove_group_from_snapshot(
+        self, state: ClusterUpgradeState, group: UpgradeGroup
+    ) -> None:
+        """Drop a group from every snapshot bucket so the REST of this
+        pass makes zero decisions about it — no processor sees it, no
+        counter counts it.  Labels are untouched: this is a pass-local
+        hold, not a state transition."""
+        for groups in state.groups.values():
+            if group in groups:
+                groups.remove(group)
+        for members in state.node_states.values():
+            for member in group.members:
+                if member in members:
+                    members.remove(member)
+
+    def process_preemption(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """Preemption fast-path: a reclaimed spot/preemptible node is NOT
+        a hardware failure.
+
+        While any member carries the platform preemption signal the
+        whole group is dropped from this pass's snapshot — it skips
+        quarantine entirely (no prior-state park, no flap-cycle count),
+        makes zero transitions, and holds no budget.  The first
+        observation releases the group's ledger claim and counts
+        ``preemptions_total{generation}`` exactly once, recorded
+        durably in the preempted-since annotation so a controller
+        restart neither double-counts nor double-releases.
+
+        On return (signal cleared) the stamp is retired and an in-flight
+        group force-reclaims its budget and continues in this same pass
+        — no hysteresis dwell: the node did not flap, it was taken and
+        given back by the platform."""
+        since_key = self.keys.preempted_since_annotation
+        unit = self._unavailability_unit(policy) if policy else "node"
+        for group in list(state.all_groups()):
+            stamped = [
+                m.node
+                for m in group.members
+                if since_key in m.node.annotations
+            ]
+            if self._group_preempted(group):
+                if not stamped:
+                    gen = (
+                        generation_of(group.slice_info.accelerator)
+                        if group.slice_info is not None
+                        else ""
+                    ) or "unknown"
+                    self.preemptions[gen] = self.preemptions.get(gen, 0) + 1
+                    with self.provider.batched():
+                        self.provider.change_nodes_upgrade_annotation(
+                            group.nodes, since_key, str(int(time.time()))
+                        )
+                    if self.budget_ledger is not None:
+                        self.budget_ledger.release(group.id)
+                    for node in group.nodes:
+                        log_event(
+                            self.event_recorder,
+                            node.name,
+                            EVENT_TYPE_NORMAL,
+                            "NodePreempted",
+                            "Slice preempted by the platform; holding "
+                            "without quarantine or budget until it "
+                            "returns",
+                        )
+                    logger.info(
+                        "group %s preempted (%s); holding budget-free",
+                        group.id,
+                        gen,
+                    )
+                self._remove_group_from_snapshot(state, group)
+                continue
+            if stamped:
+                # Every preempted host returned: clear the stamp and
+                # resume exactly where the roll stopped, this same pass.
+                with self.provider.batched():
+                    self.provider.change_nodes_upgrade_annotation(
+                        stamped, since_key, "null"
+                    )
+                eff = group.effective_state(self.keys.state_label)
+                if (
+                    self.budget_ledger is not None
+                    and eff in IN_PROGRESS_STATES
+                ):
+                    # The return is a fact, not an admission request:
+                    # force the charge back on even if the freed slot
+                    # was spent while the node was gone.
+                    self.budget_ledger.try_claim(
+                        group.id,
+                        1 if unit == "slice" else group.size(),
+                        force=True,
+                    )
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_NORMAL,
+                        "NodePreemptionReturned",
+                        "Preempted capacity returned; resuming the roll "
+                        "immediately (no re-admission dwell)",
+                    )
+                logger.info(
+                    "group %s returned from preemption; resuming", group.id
+                )
+
+    def process_maintenance_windows(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        """Hold every group of a pool whose maintenance window is closed.
+
+        The hold is a CONDITION, not a state: the window-wait annotation
+        (value = pool name) marks it, the upgrade-state label never
+        moves, and the group is dropped from this pass's snapshot so no
+        processor acts on it — zero transitions, zero budget held (any
+        ledger claim is released).  The first in-window pass clears the
+        annotation and the roll resumes where it stopped."""
+        pools = self._policy_pools(policy)
+        window_key = self.keys.window_wait_annotation
+        open_by_pool: dict[str, bool] = {}
+        for pool in pools:
+            is_open = True
+            window = pool.maintenance_window
+            if window is not None and window.cron:
+                try:
+                    is_open = window_open(window.cron)
+                except ValueError:
+                    # Schema validation rejects bad crons; an unparseable
+                    # leftover must fail OPEN — a typo in a window must
+                    # not freeze the pool forever.
+                    is_open = True
+            open_by_pool[pool.name] = is_open
+        self.pool_window_open = open_by_pool
+        held = 0
+        for group in list(state.all_groups()):
+            pool_name = self._pool_for_group(group, policy)
+            carriers = [
+                m.node
+                for m in group.members
+                if window_key in m.node.annotations
+            ]
+            if pool_name is None or open_by_pool.get(pool_name, True):
+                if carriers:
+                    self.provider.change_nodes_upgrade_annotation(
+                        carriers, window_key, "null"
+                    )
+                    logger.info(
+                        "group %s maintenance window open; resuming",
+                        group.id,
+                    )
+                continue
+            if len(carriers) != group.size():
+                with self.provider.batched():
+                    self.provider.change_nodes_upgrade_annotation(
+                        group.nodes, window_key, pool_name
+                    )
+                for node in group.nodes:
+                    log_event(
+                        self.event_recorder,
+                        node.name,
+                        EVENT_TYPE_NORMAL,
+                        "MaintenanceWindowWait",
+                        f"Pool {pool_name} is outside its maintenance "
+                        "window; holding budget-free (condition, not a "
+                        "state transition)",
+                    )
+            if self.budget_ledger is not None:
+                self.budget_ledger.release(group.id)
+            held += 1
+            self._remove_group_from_snapshot(state, group)
+        self.window_held_groups = held
+
     # -- slice quarantine (data-plane fault tolerance) -----------------------
 
     @staticmethod
@@ -1703,9 +1980,6 @@ class ClusterUpgradeStateManager:
                         st.value,
                         reason,
                     )
-                    self.provider.change_nodes_upgrade_annotation(
-                        group.nodes, prior_key, st.value
-                    )
                     # Durable flap counter: one increment per park, so a
                     # slice cycling across dwell windows is capped below
                     # (max_cycles) instead of parking forever — and the
@@ -1717,13 +1991,20 @@ class ClusterUpgradeStateManager:
                         ),
                         default=0,
                     )
-                    self.provider.change_nodes_upgrade_annotation(
-                        group.nodes, cycle_key, str(cycles)
-                    )
-                    self._clear_quarantine_dwell(group)
-                    self.provider.change_nodes_upgrade_state(
-                        group.nodes, UpgradeState.QUARANTINED
-                    )
+                    # One combined metadata patch per node: prior-state +
+                    # cycle-count annotations and the state label land in
+                    # a single API round trip.
+                    with self.provider.batched():
+                        self.provider.change_nodes_upgrade_annotation(
+                            group.nodes, prior_key, st.value
+                        )
+                        self.provider.change_nodes_upgrade_annotation(
+                            group.nodes, cycle_key, str(cycles)
+                        )
+                        self._clear_quarantine_dwell(group)
+                        self.provider.change_nodes_upgrade_state(
+                            group.nodes, UpgradeState.QUARANTINED
+                        )
                     for node in group.nodes:
                         log_event(
                             self.event_recorder,
@@ -1892,13 +2173,14 @@ class ClusterUpgradeStateManager:
                 group.id,
                 target.value,
             )
-            self.provider.change_nodes_upgrade_state(group.nodes, target)
-            self.provider.change_nodes_upgrade_annotation(
-                group.nodes, prior_key, "null"
-            )
-            self.provider.change_nodes_upgrade_annotation(
-                group.nodes, ready_key, "null"
-            )
+            with self.provider.batched():
+                self.provider.change_nodes_upgrade_state(group.nodes, target)
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes, prior_key, "null"
+                )
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes, ready_key, "null"
+                )
             for node in group.nodes:
                 log_event(
                     self.event_recorder,
@@ -1990,53 +2272,60 @@ class ClusterUpgradeStateManager:
         # progress clocks so the NEXT cycle starts with a clean ladder,
         # flap count, and attempt record.  Guarded per key (only nodes
         # actually carrying it), so the common path writes nothing.
-        for key in (
-            self.keys.quarantine_cycle_count_annotation,
-            self.keys.eviction_rung_annotation,
-            self.keys.eviction_rung_since_annotation,
-            self.keys.rollback_attempts_annotation,
-            self.keys.rollback_last_attempt_annotation,
-            self.keys.recovery_probe_since_annotation,
-            self.keys.adopted_by_annotation,
-            # Stale negotiation residue (e.g. a resize-complete stamped
-            # after the offer already timed out into the drain fallback).
-            # The exclusion + rejoin markers are NOT cleared — they must
-            # survive until rejoin-resize finishes.
-            self.keys.elastic_offer_annotation,
-            self.keys.elastic_response_annotation,
-            self.keys.elastic_resize_complete_annotation,
-        ):
-            carriers = [
-                m.node for m in group.members if key in m.node.annotations
-            ]
-            if carriers:
-                try:
-                    self.provider.change_nodes_upgrade_annotation(
-                        carriers, key, "null"
-                    )
-                except Exception as e:  # noqa: BLE001 — best-effort retire
-                    logger.warning(
-                        "clearing %s on group %s failed: %s", key, group.id, e
-                    )
-        key = self.keys.initial_state_annotation
-        if all(
-            key in m.node.annotations for m in group.members
-        ) and not self._group_elastic_excluded(group):
-            self.provider.change_nodes_upgrade_state(
-                group.nodes, UpgradeState.DONE
-            )
-            self.provider.change_nodes_upgrade_annotation(
-                group.nodes, key, "null"
-            )
-            if self.budget_ledger is not None:
-                # Straight to DONE (every host started cordoned): the
-                # uncordon processor will never see this group, so the
-                # ledger claim is released here.
-                self.budget_ledger.release(group.id)
-        else:
-            self.provider.change_nodes_upgrade_state(
-                group.nodes, UpgradeState.UNCORDON_REQUIRED
-            )
+        # Up to ~10 per-key clears plus the state flip collapse into ONE
+        # combined metadata patch per node (provider.batched): the
+        # write-amplification hot spot of every completed cycle.
+        with self.provider.batched():
+            for key in (
+                self.keys.quarantine_cycle_count_annotation,
+                self.keys.eviction_rung_annotation,
+                self.keys.eviction_rung_since_annotation,
+                self.keys.rollback_attempts_annotation,
+                self.keys.rollback_last_attempt_annotation,
+                self.keys.recovery_probe_since_annotation,
+                self.keys.adopted_by_annotation,
+                # Stale negotiation residue (e.g. a resize-complete stamped
+                # after the offer already timed out into the drain fallback).
+                # The exclusion + rejoin markers are NOT cleared — they must
+                # survive until rejoin-resize finishes.
+                self.keys.elastic_offer_annotation,
+                self.keys.elastic_response_annotation,
+                self.keys.elastic_resize_complete_annotation,
+            ):
+                carriers = [
+                    m.node for m in group.members if key in m.node.annotations
+                ]
+                if carriers:
+                    try:
+                        self.provider.change_nodes_upgrade_annotation(
+                            carriers, key, "null"
+                        )
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        logger.warning(
+                            "clearing %s on group %s failed: %s",
+                            key,
+                            group.id,
+                            e,
+                        )
+            key = self.keys.initial_state_annotation
+            if all(
+                key in m.node.annotations for m in group.members
+            ) and not self._group_elastic_excluded(group):
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.DONE
+                )
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes, key, "null"
+                )
+                if self.budget_ledger is not None:
+                    # Straight to DONE (every host started cordoned): the
+                    # uncordon processor will never see this group, so the
+                    # ledger claim is released here.
+                    self.budget_ledger.release(group.id)
+            else:
+                self.provider.change_nodes_upgrade_state(
+                    group.nodes, UpgradeState.UNCORDON_REQUIRED
+                )
 
     def _pod_in_sync_with_ds(
         self, member: NodeUpgradeState

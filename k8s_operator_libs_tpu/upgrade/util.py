@@ -172,6 +172,14 @@ class UpgradeKeys:
         return self._fmt(C.UPGRADE_ELASTIC_REJOIN_COMPLETE_ANNOTATION_KEY_FMT)
 
     @property
+    def preempted_since_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_PREEMPTED_SINCE_ANNOTATION_KEY_FMT)
+
+    @property
+    def window_wait_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_WINDOW_WAIT_ANNOTATION_KEY_FMT)
+
+    @property
     def eviction_rung_annotation(self) -> str:
         return self._fmt(C.UPGRADE_EVICTION_RUNG_ANNOTATION_KEY_FMT)
 

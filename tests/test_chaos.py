@@ -1574,3 +1574,171 @@ def test_rejoin_resize_node_fault_races_quarantine_and_times_out():
         assert live.annotations.get(keys.elastic_excluded_annotation) in (
             None, "", "null",
         )
+
+
+def test_mixed_generation_pools_roll_through_preemption_chaos():
+    """Heterogeneous-fleet chaos: one CR drives a v4 pool, a two-slice
+    v5e pool and a v6e pool, each with its own driver DaemonSet (per-pool
+    target versions) and its own budget cap, while the platform preempts
+    a v5e host mid-roll.  The invariants under fire:
+
+    - admission is oldest-generation-first (v4 enters the roll before
+      v5e, v5e before v6e);
+    - the per-pool budget never overspends (v5e cap 1 binds even though
+      the fleet cap would admit both v5e slices);
+    - preemption is NOT a failure: no quarantine, the preempted slice
+      holds no budget while gone, and it re-admits without dwell;
+    - the whole mixed fleet converges to upgrade-done.
+    """
+    from k8s_operator_libs_tpu.api.v1alpha1 import PoolSpec
+    from k8s_operator_libs_tpu.upgrade.consts import (
+        GKE_TPU_ACCELERATOR_LABEL,
+        NODE_PREEMPTION_ANNOTATION,
+    )
+    from tests.test_state_diagram import EDGES, _TransitionRecorder
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(store, keys)
+    fx = ClusterFixture(store, keys)
+
+    gens = {
+        "v4": ("tpu-v4-podslice", ["v4-a"]),
+        "v5e": ("tpu-v5-lite-podslice", ["v5e-a", "v5e-b"]),
+        "v6e": ("tpu-v6e-slice", ["v6e-a"]),
+    }
+    slices: dict[str, list] = {}
+    for gen, (accel, names) in gens.items():
+        ds = fx.daemon_set(name=f"libtpu-{gen}", hash_suffix=f"{gen}-1",
+                           revision=1)
+        for sname in names:
+            nodes = fx.tpu_slice(sname, hosts=2, topology="2x2x2",
+                                 accelerator=accel)
+            slices[sname] = nodes
+            for n in nodes:
+                fx.driver_pod(n, ds, hash_suffix=f"{gen}-1")
+        fx.bump_daemon_set_template(ds, f"{gen}-2", revision=2)
+        fx.auto_recreate_driver_pods(ds, f"{gen}-2")
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString(2),
+        unavailability_unit="slice",
+        slice_quarantine=SliceQuarantineSpec(
+            enable=True, ready_dwell_second=3600
+        ),
+        pools=[
+            PoolSpec(name="v4", driver_version="v4-2",
+                     node_selector={GKE_TPU_ACCELERATOR_LABEL:
+                                    "tpu-v4-podslice"}),
+            PoolSpec(name="v5e", driver_version="v5e-2",
+                     node_selector={GKE_TPU_ACCELERATOR_LABEL:
+                                    "tpu-v5-lite-podslice"},
+                     max_unavailable=IntOrString(1),
+                     max_parallel_upgrades=1),
+            PoolSpec(name="v6e", driver_version="v6e-2",
+                     node_selector={GKE_TPU_ACCELERATOR_LABEL:
+                                    "tpu-v6e-slice"}),
+        ],
+    )
+    policy.validate()
+    mgr = ClusterUpgradeStateManager(
+        store, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    def member_states(sname):
+        return {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[sname]
+        }
+
+    def preempted(sname):
+        return any(
+            NODE_PREEMPTION_ANNOTATION
+            in store.get_node(n.name, cached=False).annotations
+            for n in slices[sname]
+        )
+
+    pool_of = {"v4-a": "v4", "v5e-a": "v5e", "v5e-b": "v5e", "v6e-a": "v6e"}
+    settled = {"", "upgrade-required", "upgrade-done"}
+    first_admit: dict[str, int] = {}
+    victim = None
+    returned = False
+    done = False
+    for tick in range(600):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+
+        states = {s: member_states(s) for s in slices}
+        for sname, st in states.items():
+            pool = pool_of[sname]
+            if st - settled and pool not in first_admit:
+                first_admit[pool] = tick
+
+        # Budget invariants, every tick until the preempted capacity is
+        # handed back: at most ONE v5e slice in flight (pool cap) and at
+        # most two slices fleet-wide — excluding a preempted slice,
+        # which holds no budget while gone even though its labels still
+        # show the suspended roll.  After the give-back the invariant is
+        # intentionally relaxed: the returning slice is force-re-charged
+        # past the caps (its unavailability is a fact, not an admission
+        # request), so the pool transiently carries both slices.
+        if not returned:
+            v5e_rolling = [
+                s for s in ("v5e-a", "v5e-b")
+                if (states[s] - settled) and not preempted(s)
+            ]
+            assert len(v5e_rolling) <= 1, (
+                f"tick {tick}: v5e pool overspent its cap: {states}"
+            )
+            rolling = [
+                s for s in slices
+                if (states[s] - settled) and not preempted(s)
+            ]
+            assert len(rolling) <= 2, (
+                f"tick {tick}: fleet overspent: {states}"
+            )
+
+        # Preemption is never an upgrade failure.
+        assert not any("quarantined" in st for st in states.values())
+
+        if victim is None:
+            # Strike the first v5e slice that enters the roll, mid-roll.
+            for sname in ("v5e-a", "v5e-b"):
+                if states[sname] - settled:
+                    victim = f"{sname}-w1"
+                    store.fault_schedule = FaultSchedule().node_preempt(
+                        victim, max_hits=1
+                    )
+                    break
+        elif not returned and mgr.preemptions.get("v5e"):
+            # The platform hands the capacity back a few ticks later.
+            returned = True
+            store.fault_schedule = FaultSchedule().node_preempt(
+                victim, amount=0, max_hits=1
+            )
+
+        if all(st == {"upgrade-done"} for st in states.values()):
+            done = True
+            break
+
+    assert done, f"mixed-generation roll never converged: {states}"
+    assert victim is not None and returned, "preemption chaos never fired"
+    assert mgr.quarantines_total == 0
+    assert mgr.preemptions == {"v5e": 1}
+    # Oldest generation first: v4 entered the roll no later than v5e,
+    # and v5e no later than v6e.
+    assert first_admit["v4"] <= first_admit["v5e"] <= first_admit["v6e"], (
+        f"admission order not oldest-first: {first_admit}"
+    )
+    # The preemption stamp is fully retired after the node returned.
+    live = store.get_node(victim, cached=False)
+    assert NODE_PREEMPTION_ANNOTATION not in live.annotations
+    assert keys.preempted_since_annotation not in live.annotations
+    # Every transition the roll took is a documented edge.
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, f"undocumented transitions: {undocumented}"

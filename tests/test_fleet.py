@@ -1,0 +1,797 @@
+"""Heterogeneous-fleet subsystem (fleet/): generation profiles and
+per-generation probe floors, cron maintenance windows, generation-aware
+roll ordering, per-pool budget hierarchy, the preemption fast-path, and
+the write-coalescing surface those paths ride on.
+
+The engine-level scenarios (mixed-generation chaos roll, fuzzed pool
+budgets) live in test_chaos.py / test_fuzz_invariants.py; this module
+pins the component contracts they build on.
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    IntOrString,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.api.v1alpha1 import (
+    MaintenanceWindowSpec,
+    PoolSpec,
+    ValidationError,
+)
+from k8s_operator_libs_tpu.fleet import (
+    GenerationProfile,
+    generation_of,
+    generation_profile,
+    group_sort_key,
+    known_generations,
+    order_groups,
+    pool_sort_key,
+    register_generation,
+    window_open,
+)
+from k8s_operator_libs_tpu.fleet.profiles import (
+    HBM_FLOOR_FRACTION,
+    ICI_FLOOR_FRACTION,
+    MXU_FLOOR_FRACTION,
+)
+from k8s_operator_libs_tpu.fleet.windows import validate_window
+from k8s_operator_libs_tpu.health.probes import resolve_floors
+from k8s_operator_libs_tpu.hw import chip_spec
+from k8s_operator_libs_tpu.k8s import FakeCluster, FaultSchedule
+from k8s_operator_libs_tpu.metrics import UpgradeMetrics
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    NODE_PREEMPTION_ANNOTATION,
+)
+from k8s_operator_libs_tpu.upgrade.sharded import BudgetLedger
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+
+KEYS = UpgradeKeys()
+
+V4 = "tpu-v4-podslice"
+V5E = "tpu-v5-lite-podslice"
+V5P = "tpu-v5p-slice"
+V6E = "tpu-v6e-slice"
+
+
+# -- hw.chip_spec alias coverage ---------------------------------------------
+
+
+class TestChipSpecAliases:
+    @pytest.mark.parametrize(
+        "kind,name",
+        [
+            ("TPU v4", "v4"),
+            ("tpu-v4-podslice", "v4"),
+            ("TPU v5 lite", "v5e"),
+            ("tpu-v5-lite-podslice", "v5e"),
+            ("tpu-v5-lite-device", "v5e"),
+            ("TPU v5p", "v5p"),
+            ("tpu-v5p-slice", "v5p"),
+            ("TPU v5", "v5p"),  # bare-v5 libtpu fallback
+            ("TPU v6 lite", "v6e"),
+            ("tpu-v6e-slice", "v6e"),
+        ],
+    )
+    def test_device_kind_and_gke_label_aliases(self, kind, name):
+        spec = chip_spec(kind)
+        assert spec is not None and spec.name == name
+
+    def test_v5p_and_v6e_published_figures(self):
+        v5p = chip_spec("tpu-v5p-slice")
+        assert (v5p.bf16_tflops, v5p.hbm_gbps, v5p.hbm_gib) == (
+            459.0, 2765.0, 95.0,
+        )
+        v6e = chip_spec("tpu-v6e-slice")
+        assert (v6e.bf16_tflops, v6e.hbm_gbps, v6e.hbm_gib) == (
+            918.0, 1640.0, 32.0,
+        )
+
+    def test_unknown_kinds_resolve_to_none(self):
+        assert chip_spec("cpu") is None
+        assert chip_spec("") is None
+        assert chip_spec("nvidia-a100") is None
+
+
+# -- generation profiles ------------------------------------------------------
+
+
+class TestGenerationProfiles:
+    def test_builtin_registry_covers_the_fleet(self):
+        names = [p.name for p in known_generations()]
+        assert names == ["v2", "v3", "v4", "v5e", "v5p", "v6e"]
+        # known_generations is oldest-first (the canary order).
+        orders = [p.order for p in known_generations()]
+        assert orders == sorted(orders)
+
+    @pytest.mark.parametrize(
+        "kind,name",
+        [(V4, "v4"), (V5E, "v5e"), (V5P, "v5p"), (V6E, "v6e"),
+         ("TPU v5 lite", "v5e")],
+    )
+    def test_resolution_accepts_labels_and_device_kinds(self, kind, name):
+        profile = generation_profile(kind)
+        assert profile is not None and profile.name == name
+        assert generation_of(kind) == name
+
+    def test_unknown_generation_is_none_and_empty(self):
+        assert generation_profile("cpu") is None
+        assert generation_of("cpu") == ""
+
+    def test_floors_default_to_fractions_of_chip_spec(self):
+        for kind in (V4, V5E, V5P, V6E):
+            p = generation_profile(kind)
+            assert p.hbm_floor() == pytest.approx(
+                HBM_FLOOR_FRACTION * p.chip.hbm_gbps
+            )
+            assert p.mxu_floor() == pytest.approx(
+                MXU_FLOOR_FRACTION * p.chip.bf16_tflops
+            )
+            assert p.ici_floor() == pytest.approx(
+                ICI_FLOOR_FRACTION * p.ici_gbps
+            )
+
+    def test_explicit_fraction_beats_pinned_floor(self):
+        p = GenerationProfile(
+            name="pinned", chip=chip_spec(V5P), chips_per_host=4,
+            ici_gbps=600.0, watts_per_chip=350.0, order=6,
+            hbm_gbps_floor=1000.0,
+        )
+        assert p.hbm_floor() == 1000.0  # pinned wins over the default
+        assert p.hbm_floor(0.25) == pytest.approx(0.25 * 2765.0)
+
+    def test_register_generation_extends_and_overrides(self):
+        original = generation_profile(V6E)
+        try:
+            register_generation(
+                GenerationProfile(
+                    name="v6e", chip=original.chip, chips_per_host=4,
+                    ici_gbps=original.ici_gbps,
+                    watts_per_chip=original.watts_per_chip,
+                    order=original.order, preemptible=True,
+                    hbm_gbps_floor=123.0, mxu_tflops_floor=45.0,
+                )
+            )
+            p = generation_profile(V6E)
+            assert p.hbm_floor() == 123.0
+            assert p.mxu_floor() == 45.0
+        finally:
+            register_generation(original)
+        assert generation_profile(V6E).hbm_floor() == pytest.approx(
+            HBM_FLOOR_FRACTION * original.chip.hbm_gbps
+        )
+
+    @pytest.mark.parametrize("kind", [V4, V5E, V5P, V6E, "TPU v4"])
+    def test_resolve_floors_per_generation(self, kind):
+        """The probe-battery floor bundle comes from the profile — the
+        per-generation thresholds the fused battery stamps into its
+        check metrics."""
+        floors = resolve_floors(kind)
+        p = generation_profile(kind)
+        assert floors.generation == p.name
+        assert floors.mxu_tflops == pytest.approx(p.mxu_floor())
+        assert floors.hbm_gbps == pytest.approx(p.hbm_floor())
+        assert floors.ici_busbw_gbps == pytest.approx(p.ici_floor())
+        assert floors.allreduce_latency_ms == p.allreduce_latency_ceiling_ms
+
+    def test_resolve_floors_distinct_per_generation(self):
+        """A v5e pool must not be judged at v5p spec: the floor bundles
+        of the four production generations are pairwise distinct."""
+        hbm = {k: resolve_floors(k).hbm_gbps for k in (V4, V5E, V5P, V6E)}
+        assert len(set(hbm.values())) == 4
+        assert hbm[V5E] < hbm[V5P]  # the lite chip gates lower
+
+    def test_resolve_floors_unknown_kind_is_none(self):
+        assert resolve_floors("cpu") is None
+        assert resolve_floors("") is None
+        assert resolve_floors("gpu,cpu") is None  # mixed battery key
+
+    def test_preemptible_metadata(self):
+        assert generation_profile(V5E).preemptible
+        assert generation_profile(V6E).preemptible
+        assert not generation_profile(V5P).preemptible
+
+
+# -- generation-aware roll ordering ------------------------------------------
+
+
+def _group(gid: str, accelerator: str = ""):
+    info = SimpleNamespace(accelerator=accelerator) if accelerator else None
+    return SimpleNamespace(id=gid, slice_info=info)
+
+
+class TestScheduler:
+    def test_oldest_generation_first_then_id(self):
+        groups = [
+            _group("b-v6e", V6E),
+            _group("a-v4", V4),
+            _group("c-v5e", V5E),
+            _group("d-v5p", V5P),
+            _group("z-plain"),  # unknown generation: proves nothing, last
+            _group("a-v4-2", V4),
+        ]
+        ordered = [g.id for g in order_groups(groups)]
+        assert ordered == [
+            "a-v4", "a-v4-2", "c-v5e", "d-v5p", "b-v6e", "z-plain",
+        ]
+
+    def test_deterministic_across_input_permutations(self):
+        groups = [
+            _group("g1", V5P), _group("g2", V4), _group("g3", V6E),
+            _group("g4"), _group("g5", V5E),
+        ]
+        want = [g.id for g in order_groups(groups)]
+        assert [g.id for g in order_groups(reversed(groups))] == want
+        assert [g.id for g in order_groups(groups[2:] + groups[:2])] == want
+
+    def test_group_sort_key_is_pure_and_label_driven(self):
+        # Same accelerator -> same generation key; tie broken by id only.
+        k1 = group_sort_key(_group("a", V4))
+        k2 = group_sort_key(_group("b", V4))
+        assert k1[:-1] == k2[:-1] and k1 < k2
+
+    def test_pool_sort_key_orders_dirty_pools_oldest_first(self):
+        accel = {"p-new": V6E, "p-old": V4, "p-mid": V5E}
+        key = pool_sort_key(accel.get)
+        ordered = sorted(["p-new", "p-unknown", "p-old", "p-mid"], key=key)
+        assert ordered == ["p-old", "p-mid", "p-new", "p-unknown"]
+
+
+# -- maintenance windows ------------------------------------------------------
+
+
+def _utc(y, mo, d, h, mi) -> float:
+    return float(calendar.timegm((y, mo, d, h, mi, 0, 0, 0, 0)))
+
+
+class TestWindows:
+    def test_hour_range_membership(self):
+        cron = "* 2-5 * * *"
+        assert window_open(cron, _utc(2026, 8, 5, 2, 0))
+        assert window_open(cron, _utc(2026, 8, 5, 5, 59))
+        assert not window_open(cron, _utc(2026, 8, 5, 6, 0))
+        assert not window_open(cron, _utc(2026, 8, 5, 1, 59))
+
+    def test_weekend_window_dow_0_and_7_are_sunday(self):
+        sat = _utc(2026, 8, 1, 3, 0)
+        sun = _utc(2026, 8, 2, 3, 0)
+        mon = _utc(2026, 8, 3, 3, 0)
+        for cron in ("* 2-5 * * 6,0", "* 2-5 * * 6,7"):
+            assert window_open(cron, sat)
+            assert window_open(cron, sun)
+            assert not window_open(cron, mon)
+
+    def test_steps_and_lists(self):
+        cron = "*/15 * * * *"
+        assert window_open(cron, _utc(2026, 8, 5, 10, 30))
+        assert not window_open(cron, _utc(2026, 8, 5, 10, 31))
+        assert window_open("5,35 * * * *", _utc(2026, 8, 5, 10, 35))
+
+    def test_dom_dow_or_rule_when_both_restricted(self):
+        # Standard cron: day-of-month 15 OR Sunday.
+        cron = "* * 15 * 0"
+        assert window_open(cron, _utc(2026, 8, 15, 3, 0))  # Saturday the 15th
+        assert window_open(cron, _utc(2026, 8, 2, 3, 0))  # Sunday the 2nd
+        assert not window_open(cron, _utc(2026, 8, 3, 3, 0))  # Monday the 3rd
+
+    @pytest.mark.parametrize(
+        "cron",
+        ["", "* * * *", "61 * * * *", "* 2-1 * * *", "a * * * *",
+         "*/0 * * * *", "* * * 13 *"],
+    )
+    def test_validate_window_rejects_malformed(self, cron):
+        with pytest.raises(ValueError):
+            validate_window(cron)
+
+    def test_validate_window_accepts_standard_shapes(self):
+        for cron in ("* * * * *", "* 2-5 * * 6,0", "*/15 0-3 1-7 * *"):
+            validate_window(cron)  # no raise
+
+
+# -- PoolSpec schema / CR round-trip ------------------------------------------
+
+
+class TestPoolSpec:
+    def test_cr_round_trip_with_pools(self):
+        spec = {
+            "autoUpgrade": True,
+            "pools": [
+                {
+                    "name": "v4-canary",
+                    "nodeSelector": {GKE_TPU_ACCELERATOR_LABEL: V4},
+                    "driverVersion": "v2",
+                    "maxUnavailable": "50%",
+                    "maxParallelUpgrades": 1,
+                    "maintenanceWindow": {"cron": "* 2-5 * * 6,0"},
+                },
+                {"name": "v5e", "nodeSelector": {GKE_TPU_ACCELERATOR_LABEL: V5E}},
+            ],
+        }
+        policy = TPUUpgradePolicySpec.from_dict(spec)
+        policy.validate()
+        assert [p.name for p in policy.pools] == ["v4-canary", "v5e"]
+        assert policy.pools[0].max_unavailable == IntOrString("50%")
+        assert policy.pools[0].maintenance_window.cron == "* 2-5 * * 6,0"
+        assert policy.pools[1].maintenance_window is None
+        rt = TPUUpgradePolicySpec.from_dict(policy.to_dict())
+        assert rt == policy
+
+    def test_duplicate_pool_names_rejected(self):
+        policy = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            pools=[PoolSpec(name="a"), PoolSpec(name="a")],
+        )
+        with pytest.raises(ValidationError, match="duplicate pool"):
+            policy.validate()
+
+    def test_empty_pool_name_rejected(self):
+        with pytest.raises(ValidationError, match="name"):
+            PoolSpec(name="").validate()
+
+    def test_bad_cron_rejected_with_pool_context(self):
+        pool = PoolSpec(
+            name="v4", maintenance_window=MaintenanceWindowSpec(cron="bad")
+        )
+        with pytest.raises(ValidationError, match="v4"):
+            pool.validate()
+
+    def test_negative_parallel_rejected(self):
+        with pytest.raises(ValidationError, match="maxParallelUpgrades"):
+            PoolSpec(name="v4", max_parallel_upgrades=-1).validate()
+
+
+# -- per-pool budget hierarchy (ledger unit view) ----------------------------
+
+
+class TestLedgerPoolCaps:
+    def _ledger(self) -> BudgetLedger:
+        ledger = BudgetLedger()
+        ledger.configure(
+            total_units=8, max_parallel=0, max_unavailable=8, unit="slice"
+        )
+        ledger.configure_pools({"v4": (1, 1), "v5e": (2, 0)})
+        return ledger
+
+    def test_pool_cap_denies_inside_fleet_headroom(self):
+        ledger = self._ledger()
+        assert ledger.try_claim("g1", 1, pool="v4")
+        # Fleet has 7 units of headroom, but pool v4 is capped at 1.
+        assert not ledger.try_claim("g2", 1, pool="v4")
+        assert ledger.pool_unavailable_used("v4") == 1
+        # Another pool is unaffected.
+        assert ledger.try_claim("g3", 1, pool="v5e")
+        assert ledger.try_claim("g4", 1, pool="v5e")
+        assert not ledger.try_claim("g5", 1, pool="v5e")  # pool cap 2
+        ledger.release("g1")
+        assert ledger.try_claim("g2", 1, pool="v4")
+
+    def test_fleet_cap_still_binds_under_pool_headroom(self):
+        ledger = BudgetLedger()
+        ledger.configure(
+            total_units=8, max_parallel=0, max_unavailable=1, unit="slice"
+        )
+        ledger.configure_pools({"v5e": (4, 0)})
+        assert ledger.try_claim("g1", 1, pool="v5e")
+        # Pool allows 4, the FLEET allows 1: fleet ∧ pool.
+        assert not ledger.try_claim("g2", 1, pool="v5e")
+
+    def test_pool_parallel_cap(self):
+        ledger = self._ledger()
+        assert ledger.try_claim("g1", 0, pool="v4")  # zero-cost claim
+        assert not ledger.try_claim("g2", 0, pool="v4")  # parallel cap 1
+        assert ledger.pool_parallel_used("v4") == 1
+
+    def test_pool_resolver_supplies_pool_when_omitted(self):
+        ledger = self._ledger()
+        ledger.pool_resolver = {"g1": "v4", "g2": "v4"}.get
+        assert ledger.try_claim("g1", 1)
+        assert not ledger.try_claim("g2", 1)
+        snap = ledger.snapshot()
+        assert snap["pool_of_charge"] == {"g1": "v4"}
+        assert snap["pool_caps"]["v4"] == (1, 1)
+
+    def test_idempotent_reclaim_keeps_single_pool_charge(self):
+        ledger = self._ledger()
+        assert ledger.try_claim("g1", 1, pool="v4")
+        assert ledger.try_claim("g1", 1, pool="v4")
+        assert ledger.pool_unavailable_used("v4") == 1
+        ledger.release("g1")
+        assert ledger.pool_unavailable_used("v4") == 0
+
+
+# -- engine: pools, windows, preemption ---------------------------------------
+
+
+def _mixed_fleet(client, keys=KEYS):
+    """One v4 slice + one v5e slice, both outdated at driver v1 -> v2."""
+    fx = ClusterFixture(client, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    v4_nodes = fx.tpu_slice(
+        "v4-pool", hosts=2, topology="2x2x2", accelerator=V4
+    )
+    v5e_nodes = fx.tpu_slice(
+        "v5e-pool", hosts=2, topology="2x2x2", accelerator=V5E
+    )
+    for n in v4_nodes + v5e_nodes:
+        fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    return fx, v4_nodes, v5e_nodes
+
+
+def _pools_policy(**pool_kw) -> TPUUpgradePolicySpec:
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        pools=[
+            PoolSpec(
+                name="v4",
+                node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                driver_version="v2",
+                **pool_kw.get("v4", {}),
+            ),
+            PoolSpec(
+                name="v5e",
+                node_selector={GKE_TPU_ACCELERATOR_LABEL: V5E},
+                driver_version="v2",
+                **pool_kw.get("v5e", {}),
+            ),
+        ],
+        **{k: v for k, v in pool_kw.items() if k not in ("v4", "v5e")},
+    )
+
+
+def make_manager(client, **kw):
+    return ClusterUpgradeStateManager(
+        client, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0, **kw
+    )
+
+
+class TestEngineHeterogeneous:
+    def test_pool_for_group_first_match_in_cr_order(self):
+        c = FakeCluster()
+        _mixed_fleet(c)
+        mgr = make_manager(c)
+        policy = _pools_policy()
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        pools = {
+            g.id: mgr._pool_for_group(g, policy)
+            for g in state.all_groups()
+        }
+        assert pools == {"v4-pool": "v4", "v5e-pool": "v5e"}
+
+    def test_admission_orders_oldest_generation_first(self):
+        """Both pools need upgrading and the budget admits one: the v4
+        slice (older generation) must be admitted first even though the
+        v5e pool sorts first lexically."""
+        c = FakeCluster()
+        _mixed_fleet(c)
+        policy = _pools_policy(
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            unavailability_unit="slice",
+        )
+        mgr = make_manager(c)
+        for _ in range(6):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            v4_states = {
+                state_of(c, KEYS, f"v4-pool-w{i}") for i in range(2)
+            }
+            if v4_states != {"upgrade-required"}:
+                break
+        assert {
+            state_of(c, KEYS, f"v5e-pool-w{i}") for i in range(2)
+        } == {"upgrade-required"}, "v5e was admitted before the v4 canary"
+        assert v4_states != {"upgrade-required"}
+
+    def test_window_closed_pool_makes_zero_transitions_holds_no_budget(self):
+        c = FakeCluster()
+        _mixed_fleet(c)
+        # The v4 pool's window is certainly closed right now (a 1-minute
+        # window half an hour away); v5e has no window (always open).
+        closed_cron = f"{(time.gmtime().tm_min + 30) % 60} * * * *"
+        policy = _pools_policy(
+            v4={"maintenance_window": MaintenanceWindowSpec(cron=closed_cron)},
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            unavailability_unit="slice",
+        )
+        mgr = make_manager(c)
+        transitions: list = []
+        orig_patch = c.patch_node_labels
+
+        def watch_patch(name, patch):
+            if KEYS.state_label in patch and name.startswith("v4-pool"):
+                transitions.append((name, patch[KEYS.state_label]))
+            return orig_patch(name, patch)
+
+        c.patch_node_labels = watch_patch
+        for _ in range(8):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+        # Zero state transitions for the held pool; the condition is the
+        # window-wait annotation, value = pool name.
+        assert transitions == []
+        assert mgr.pool_window_open == {"v4": False, "v5e": True}
+        assert mgr.window_held_groups == 1
+        for i in range(2):
+            node = c.get_node(f"v4-pool-w{i}", cached=False)
+            assert node.annotations[KEYS.window_wait_annotation] == "v4"
+        # The held pool holds no budget: the 1-slice budget went to v5e.
+        v5e_states = {
+            state_of(c, KEYS, f"v5e-pool-w{i}") for i in range(2)
+        }
+        assert v5e_states != {"upgrade-required"}
+        # Metrics surface the hold.
+        metrics = UpgradeMetrics()
+        snap = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        metrics.observe(mgr, snap, 0.0)
+        rendered = metrics.registry.render()
+        assert 'fleet_pool_window_open{pool="v4"} 0' in rendered
+        assert 'fleet_pool_window_open{pool="v5e"} 1' in rendered
+        assert "fleet_window_held_groups 1" in rendered
+
+    def test_window_opening_clears_hold_and_resumes(self):
+        c = FakeCluster()
+        _mixed_fleet(c)
+        closed_cron = f"{(time.gmtime().tm_min + 30) % 60} * * * *"
+        policy = _pools_policy(
+            v4={"maintenance_window": MaintenanceWindowSpec(cron=closed_cron)}
+        )
+        mgr = make_manager(c)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        assert (
+            c.get_node("v4-pool-w0", cached=False)
+            .annotations.get(KEYS.window_wait_annotation) == "v4"
+        )
+        # The window opens (always-open cron): the stamp clears and the
+        # pool transitions this same pass.
+        policy.pools[0].maintenance_window = MaintenanceWindowSpec(
+            cron="* * * * *"
+        )
+        # The previously-held pool re-enters the roll (behind whatever
+        # budget the v5e roll still holds) and the fleet converges.
+        for _ in range(40):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            v4_states = {
+                state_of(c, KEYS, f"v4-pool-w{i}") for i in range(2)
+            }
+            if v4_states == {"upgrade-done"}:
+                break
+        for i in range(2):
+            node = c.get_node(f"v4-pool-w{i}", cached=False)
+            assert KEYS.window_wait_annotation not in node.annotations
+        assert mgr.window_held_groups == 0
+        assert v4_states == {"upgrade-done"}
+
+    def test_preempted_group_skips_quarantine_and_holds_no_budget(self):
+        c = FakeCluster()
+        _mixed_fleet(c)
+        policy = _pools_policy(
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            unavailability_unit="slice",
+        )
+        from k8s_operator_libs_tpu.api import SliceQuarantineSpec
+
+        policy.slice_quarantine = SliceQuarantineSpec(
+            enable=True, ready_dwell_second=3600
+        )
+        mgr = make_manager(c)
+        # Drive the v4 canary into the roll.
+        in_flight = {
+            "cordon-required", "wait-for-jobs-required",
+            "pod-deletion-required", "drain-required",
+        }
+        for _ in range(10):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            v4_states = {
+                state_of(c, KEYS, f"v4-pool-w{i}") for i in range(2)
+            }
+            if v4_states & in_flight:
+                break
+        assert v4_states & in_flight
+        # The platform reclaims a v4 host: annotation + NotReady.
+        c.fault_schedule = FaultSchedule().node_preempt(
+            "v4-pool-w1", max_hits=1
+        )
+        before = dict(v4_states_by_node(c))
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        # NOT a failure: no quarantine, no transition, counted once.
+        after = dict(v4_states_by_node(c))
+        assert after == before
+        assert "quarantined" not in set(after.values())
+        assert mgr.quarantines_total == 0
+        assert mgr.preemptions == {"v4": 1}
+        stamp = c.get_node("v4-pool-w1", cached=False).annotations[
+            KEYS.preempted_since_annotation
+        ]
+        assert stamp.isdigit()
+        # Budget-free while gone: the freed slice budget admits v5e.
+        for _ in range(6):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            v5e_states = {
+                state_of(c, KEYS, f"v5e-pool-w{i}") for i in range(2)
+            }
+            if v5e_states != {"upgrade-required"}:
+                break
+        assert v5e_states != {"upgrade-required"}
+        # A second observation does not double-count.
+        assert mgr.preemptions == {"v4": 1}
+        # Metrics carry the generation label.
+        metrics = UpgradeMetrics()
+        snap = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        metrics.observe(mgr, snap, 0.0)
+        assert (
+            'preemptions_total{generation="v4"} 1'
+            in metrics.registry.render()
+        )
+
+    def test_preemption_return_readmits_without_dwell(self):
+        c = FakeCluster()
+        _mixed_fleet(c)
+        policy = _pools_policy(
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            unavailability_unit="slice",
+        )
+        mgr = make_manager(c)
+        in_flight = {
+            "cordon-required", "wait-for-jobs-required",
+            "pod-deletion-required", "drain-required",
+        }
+        for _ in range(10):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            if {
+                state_of(c, KEYS, f"v4-pool-w{i}") for i in range(2)
+            } & in_flight:
+                break
+        c.fault_schedule = FaultSchedule().node_preempt(
+            "v4-pool-w1", max_hits=1
+        )
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        assert mgr.preemptions == {"v4": 1}
+        # The node comes back (amount=0 clears + restores readiness).
+        c.fault_schedule = FaultSchedule().node_preempt(
+            "v4-pool-w1", amount=0, max_hits=1
+        )
+        c.get_node("v4-pool-w1", cached=False)  # tick the schedule
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        node = c.get_node("v4-pool-w1", cached=False)
+        # Stamp retired, no dwell: the roll resumed this same pass (and
+        # the whole roll can converge from here).
+        assert KEYS.preempted_since_annotation not in node.annotations
+        assert NODE_PREEMPTION_ANNOTATION not in node.annotations
+        for _ in range(60):
+            state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            mgr.apply_state(state, policy)
+            mgr.wait_for_async_work(10.0)
+            all_states = {
+                state_of(c, KEYS, n)
+                for n in (
+                    "v4-pool-w0", "v4-pool-w1", "v5e-pool-w0", "v5e-pool-w1"
+                )
+            }
+            if all_states == {"upgrade-done"}:
+                break
+        assert all_states == {"upgrade-done"}
+        assert mgr.quarantines_total == 0
+
+
+def v4_states_by_node(c):
+    for i in range(2):
+        name = f"v4-pool-w{i}"
+        yield name, c.get_node(name, cached=False).labels.get(
+            KEYS.state_label, ""
+        )
+
+
+# -- write coalescing + api_writes_per_tick -----------------------------------
+
+
+class TestWriteCoalescing:
+    def test_batched_writes_one_metadata_patch_per_node(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        nodes = fx.tpu_slice("pool-a", hosts=2, topology="2x2x2")
+        mgr = make_manager(c)
+        base = dict(c.stats)
+        with mgr.provider.batched():
+            mgr.provider.change_nodes_upgrade_state(
+                nodes, UpgradeState.QUARANTINED
+            )
+            mgr.provider.change_nodes_upgrade_annotation(
+                nodes, KEYS.quarantine_prior_state_annotation, "drain-required"
+            )
+            mgr.provider.change_nodes_upgrade_annotation(
+                nodes, KEYS.quarantine_cycle_count_annotation, "1"
+            )
+        delta = {
+            k: v - base.get(k, 0) for k, v in c.stats.items()
+            if v != base.get(k, 0)
+        }
+        # One combined label+annotation patch per node, not 3 writes each
+        # (all node patch variants tick the same "patch_node" verb).
+        assert delta.get("patch_node") == 2
+        for n in nodes:
+            live = c.get_node(n.name, cached=False)
+            assert live.labels[KEYS.state_label] == "quarantined"
+            assert (
+                live.annotations[KEYS.quarantine_cycle_count_annotation]
+                == "1"
+            )
+
+    def test_api_writes_per_tick_metric(self):
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        n = fx.node()
+        fx.driver_pod(n, ds)
+        mgr = make_manager(c)
+        metrics = UpgradeMetrics()
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        metrics.observe(mgr, state, 0.0)  # baseline
+        c.patch_node_labels(n.name, {"x": "y"})
+        c.patch_node_labels(n.name, {"x": "z"})
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS)
+        metrics.observe(mgr, state, 0.0)
+        rendered = metrics.registry.render()
+        assert "api_writes_per_tick 2" in rendered
+
+
+# -- status CLI: per-generation fleet section ---------------------------------
+
+
+class TestStatusFleetSection:
+    def test_gather_and_render_fleet_by_generation(self):
+        from k8s_operator_libs_tpu.status import gather, render
+
+        c = FakeCluster()
+        fx, v4_nodes, _ = _mixed_fleet(c)
+        c.patch_node_annotations(
+            v4_nodes[0].name, {NODE_PREEMPTION_ANNOTATION: "true"}
+        )
+        c.patch_node_annotations(
+            v4_nodes[0].name, {KEYS.window_wait_annotation: "v4"}
+        )
+        status = gather(c, NAMESPACE, DRIVER_LABELS, keys=KEYS)
+        fleet = status["fleet"]
+        assert fleet["generations"]["v4"] == {
+            "nodes": 2, "groups": 1, "preempted": 1,
+        }
+        assert fleet["generations"]["v5e"]["nodes"] == 2
+        assert fleet["windowHolds"] == {"v4": 1}
+        text = render(status)
+        assert "fleet by generation:" in text
+        assert "1 preempted" in text
+        assert "maintenance-window holds: v4=1 group(s)" in text

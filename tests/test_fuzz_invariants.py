@@ -809,3 +809,181 @@ def test_random_elastic_rolls_excluded_slices_hold_no_budget(seed):
     assert not undocumented, (
         f"seed {seed}: undocumented transitions {undocumented}"
     )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_heterogeneous_pools_hold_budget_and_window_invariants(seed):
+    """Heterogeneous-fleet fuzz rules: (1) a pool NEVER overspends its
+    own ``maxUnavailable`` even when the fleet budget has headroom, and
+    (2) a pool outside its maintenance window makes zero state
+    transitions and holds zero budget while closed.
+
+    Each seed rolls a random mix of v4/v5e/v6e pools (1-2 slices each)
+    under per-pool 1-slice caps, with one randomly chosen pool gated by
+    a closed cron window.  Once every other pool converges the window
+    opens and the held pool must roll to done; every transition must be
+    a documented edge."""
+    from k8s_operator_libs_tpu.api.v1alpha1 import (
+        MaintenanceWindowSpec,
+        PoolSpec,
+    )
+    from k8s_operator_libs_tpu.upgrade.consts import (
+        GKE_TPU_ACCELERATOR_LABEL,
+    )
+
+    rng = random.Random(11000 + seed)
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    recorder = _TransitionRecorder(cluster, keys)
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    gens = [
+        ("v4", "tpu-v4-podslice"),
+        ("v5e", "tpu-v5-lite-podslice"),
+        ("v6e", "tpu-v6e-slice"),
+    ]
+    slices: dict[str, list] = {}
+    pool_slices: dict[str, list[str]] = {}
+    for gen, accel in gens:
+        pool_slices[gen] = []
+        for i in range(rng.randint(1, 2)):
+            sname = f"{gen}-{i}"
+            slices[sname] = fx.tpu_slice(
+                sname, hosts=2, topology="2x2x2", accelerator=accel
+            )
+            pool_slices[gen].append(sname)
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    held_pool = rng.choice([g for g, _ in gens])
+    closed_cron = f"{(time.gmtime().tm_min + 30) % 60} * * * *"
+    pools = [
+        PoolSpec(
+            name=gen,
+            node_selector={GKE_TPU_ACCELERATOR_LABEL: accel},
+            max_unavailable=IntOrString(1),
+            max_parallel_upgrades=rng.choice([0, 1]),
+            maintenance_window=(
+                MaintenanceWindowSpec(cron=closed_cron)
+                if gen == held_pool
+                else None
+            ),
+        )
+        for gen, accel in gens
+    ]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=rng.randint(2, 3),
+        max_unavailable=IntOrString(2),
+        unavailability_unit="slice",
+        pools=pools,
+    )
+    policy.validate()
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    held_nodes = {
+        n.name for s in pool_slices[held_pool] for n in slices[s]
+    }
+    held_transitions: list = []
+    orig_patch = cluster.patch_node_labels
+
+    def watch_patch(name, patch):
+        if keys.state_label in patch and name in held_nodes:
+            held_transitions.append((name, patch[keys.state_label]))
+        return orig_patch(name, patch)
+
+    cluster.patch_node_labels = watch_patch
+
+    def slice_cordoned(sname):
+        return any(
+            cluster.get_node(n.name, cached=False).spec.unschedulable
+            for n in slices[sname]
+        )
+
+    window_opened = False
+    states: set = set()
+    for tick in range(500):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        assert mgr.wait_for_async_work(30.0)
+
+        # (1) Per-pool budget: cordoned slices per pool never exceed the
+        # pool's 1-slice maxUnavailable.
+        for gen, snames in pool_slices.items():
+            cordoned = [s for s in snames if slice_cordoned(s)]
+            assert len(cordoned) <= 1, (
+                f"seed {seed} tick {tick}: pool {gen} overspent its "
+                f"1-slice cap: {cordoned}"
+            )
+
+        if not window_opened:
+            # (2) Closed window: zero transitions, zero cordons, zero
+            # budget for the held pool — only the window-wait condition.
+            assert held_transitions == [], (
+                f"seed {seed} tick {tick}: window-held pool {held_pool} "
+                f"transitioned: {held_transitions}"
+            )
+            assert not any(
+                slice_cordoned(s) for s in pool_slices[held_pool]
+            )
+            others_done = all(
+                cluster.get_node(n.name, cached=False).labels.get(
+                    keys.state_label, ""
+                )
+                == "upgrade-done"
+                for gen, snames in pool_slices.items()
+                if gen != held_pool
+                for s in snames
+                for n in slices[s]
+            )
+            if others_done:
+                # The held groups carry the window-wait condition, not a
+                # state; then the window opens.
+                assert mgr.window_held_groups == len(
+                    pool_slices[held_pool]
+                )
+                for s in pool_slices[held_pool]:
+                    assert any(
+                        cluster.get_node(n.name, cached=False)
+                        .annotations.get(keys.window_wait_annotation)
+                        == held_pool
+                        for n in slices[s]
+                    )
+                for p in policy.pools:
+                    if p.name == held_pool:
+                        p.maintenance_window = MaintenanceWindowSpec(
+                            cron="* * * * *"
+                        )
+                window_opened = True
+
+        states = {
+            cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for nodes in slices.values()
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+    else:
+        pytest.fail(
+            f"seed {seed}: heterogeneous roll never converged "
+            f"(states {sorted(states)}, window_opened={window_opened})"
+        )
+
+    assert window_opened, (
+        f"seed {seed}: the non-held pools never all converged"
+    )
+    for nodes in slices.values():
+        for n in nodes:
+            live = cluster.get_node(n.name, cached=False)
+            assert keys.window_wait_annotation not in live.annotations
+    undocumented = recorder.observed - EDGES
+    assert not undocumented, (
+        f"seed {seed}: undocumented transitions {undocumented}"
+    )

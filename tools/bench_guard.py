@@ -516,6 +516,156 @@ def measure_elastic(
     }
 
 
+def measure_heterogeneous(max_ticks: int = 400) -> dict:
+    """Heterogeneous-fleet stage: one CR rolls a v4 + v5e + v6e pool mix
+    under a serial fleet budget; returns the artifact dict (also
+    embedded in BENCH_DETAILS.json by bench.py).
+
+    The pins: (1) admission is oldest-generation-first (the v4 canary
+    enters the roll before v5e, v5e before v6e); (2) a pool outside its
+    maintenance window makes ZERO state transitions and holds ZERO
+    budget — the other pools must spend it while the held pool waits —
+    and once the window opens the whole fleet converges."""
+    import time
+
+    from k8s_operator_libs_tpu.api import (
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.api.v1alpha1 import (
+        MaintenanceWindowSpec,
+        PoolSpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+    )
+    from k8s_operator_libs_tpu.upgrade.consts import (
+        GKE_TPU_ACCELERATOR_LABEL,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    pools = {
+        "v4": "tpu-v4-podslice",
+        "v5e": "tpu-v5-lite-podslice",
+        "v6e": "tpu-v6e-slice",
+    }
+    slices = {
+        gen: fx.tpu_slice(
+            f"{gen}-0", hosts=2, topology="2x2x2", accelerator=accel
+        )
+        for gen, accel in pools.items()
+    }
+    for nodes in slices.values():
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+
+    # The v6e pool's window is closed until the two older pools finish
+    # (a 1-minute window half an hour away fails closed now).
+    closed_cron = f"{(time.gmtime().tm_min + 30) % 60} * * * *"
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        pools=[
+            PoolSpec(
+                name=gen,
+                node_selector={GKE_TPU_ACCELERATOR_LABEL: accel},
+                maintenance_window=(
+                    MaintenanceWindowSpec(cron=closed_cron)
+                    if gen == "v6e"
+                    else None
+                ),
+            )
+            for gen, accel in pools.items()
+        ],
+    )
+    policy.validate()
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+    held_nodes = {n.name for n in slices["v6e"]}
+    held_transitions = 0
+    orig_patch = cluster.patch_node_labels
+
+    def watch_patch(name, patch):
+        nonlocal held_transitions
+        if keys.state_label in patch and name in held_nodes:
+            held_transitions += 1
+        return orig_patch(name, patch)
+
+    cluster.patch_node_labels = watch_patch
+
+    def pool_states(gen):
+        return {
+            cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in slices[gen]
+        }
+
+    settled = {"", "upgrade-required", "upgrade-done"}
+    first_admit: dict[str, int] = {}
+    transitions_while_closed = held_cordons_while_closed = 0
+    window_opened = False
+    converged = False
+    t0 = time.monotonic()
+    for tick in range(max_ticks):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(30.0)
+        states = {gen: pool_states(gen) for gen in pools}
+        for gen, st in states.items():
+            if st - settled and gen not in first_admit:
+                first_admit[gen] = tick
+        if not window_opened:
+            transitions_while_closed = held_transitions
+            held_cordons_while_closed += sum(
+                1
+                for n in slices["v6e"]
+                if cluster.get_node(n.name, cached=False).spec.unschedulable
+            )
+            if (
+                states["v4"] == {"upgrade-done"}
+                and states["v5e"] == {"upgrade-done"}
+            ):
+                policy.pools[2].maintenance_window = MaintenanceWindowSpec(
+                    cron="* * * * *"
+                )
+                window_opened = True
+        if all(st == {"upgrade-done"} for st in states.values()):
+            converged = True
+            break
+    wall_s = time.monotonic() - t0
+
+    order = sorted(first_admit, key=first_admit.get)
+    return {
+        "stage": "heterogeneous",
+        "pools": len(pools),
+        "nodes": sum(len(ns) for ns in slices.values()),
+        "converged": converged,
+        "window_opened": window_opened,
+        "ticks": tick + 1,
+        "wall_s": round(wall_s, 3),
+        "first_admit_ticks": first_admit,
+        "admission_order": order,
+        "oldest_first": order[:2] == ["v4", "v5e"],
+        "held_transitions_while_closed": transitions_while_closed,
+        "held_cordons_while_closed": held_cordons_while_closed,
+        "window_held_groups_peak": 1 if window_opened else 0,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -664,6 +814,36 @@ def main() -> int:
                 f"bench-guard FAIL (elastic fallback): {f}",
                 file=sys.stderr,
             )
+        return 1
+
+    hetero = measure_heterogeneous()
+    failures = []
+    if not hetero["converged"]:
+        failures.append(
+            "mixed-generation roll did not converge to upgrade-done"
+        )
+    if not hetero["oldest_first"]:
+        failures.append(
+            f"admission order {hetero['admission_order']} is not "
+            "oldest-generation-first (want v4 before v5e)"
+        )
+    if hetero["held_transitions_while_closed"]:
+        failures.append(
+            f"window-held pool made "
+            f"{hetero['held_transitions_while_closed']} state "
+            "transition(s) while its window was closed (must be 0)"
+        )
+    if hetero["held_cordons_while_closed"]:
+        failures.append(
+            f"window-held pool held budget while closed "
+            f"({hetero['held_cordons_while_closed']} cordoned-node "
+            "observations; must be 0)"
+        )
+    hetero["ok"] = not failures
+    print(json.dumps(hetero, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (heterogeneous): {f}", file=sys.stderr)
         return 1
     return 0
 
