@@ -1353,6 +1353,7 @@ def main() -> None:
         measure_elastic as measure_elastic_roll,
         measure_heterogeneous as measure_heterogeneous_roll,
         measure_sharded as measure_sharded_reconcile,
+        measure_write_hygiene,
     )
 
     cached_reconcile = measure_cached_reconcile()
@@ -1392,6 +1393,17 @@ def main() -> None:
     heterogeneous = measure_heterogeneous_roll()
     beat()
     log(f"heterogeneous roll (v4+v5e+v6e pools): {heterogeneous}")
+
+    # -- write hygiene: the transactional write plane (gated by
+    # `make bench-guard`) ----------------------------------------------------
+    # Three pins on the write path: an active 256-node roll stays within
+    # the writes-per-transition budget (label + clock annotations
+    # coalesce into one patch), a 4096-node sharded idle tick issues
+    # exactly 0 writes, and an identical-event storm collapses >= 10:1
+    # through the aggregator.
+    write_hygiene = measure_write_hygiene()
+    beat()
+    log(f"write hygiene (coalesce/suppress/aggregate): {write_hygiene}")
 
     complete = seq_result["complete"]
     details = {
@@ -1447,6 +1459,7 @@ def main() -> None:
             "decline_fallback": elastic_fallback,
         },
         "heterogeneous": heterogeneous,
+        "write_hygiene": write_hygiene,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1528,6 +1541,13 @@ def main() -> None:
         "sharded_idle_p99_tick_s": sharded_reconcile["idle_p99_tick_s"],
         "sharded_active_pools_walked": sharded_reconcile[
             "active_pools_walked"
+        ],
+        "write_hygiene_writes_per_transition": write_hygiene[
+            "roll_writes_per_transition"
+        ],
+        "write_hygiene_idle_writes": write_hygiene["idle_writes_total"],
+        "write_hygiene_event_collapse": write_hygiene[
+            "event_collapse_ratio"
         ],
         "elastic_downtime_s": elastic_roll["downtime_s"],
         "elastic_max_gap_s": elastic_roll["max_gap_s"],

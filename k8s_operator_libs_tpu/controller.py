@@ -398,6 +398,14 @@ class UpgradeController:
             return 0
         return len(breaker.open_endpoints())
 
+    @property
+    def write_plan(self):
+        """The manager's transactional write plane (None with injected
+        fake managers): CR status and Events route through it so status
+        churn rides the status flow — never the mutating one — and a
+        deposed leader's queued writes drop at flush."""
+        return getattr(self.manager, "write_plan", None)
+
     def _handle_circuit_open(self, exc: CircuitOpenError) -> None:
         """Degrade gracefully instead of crashing or wedging: log once
         per pass, publish the gauge, and best-effort surface a Degraded
@@ -436,9 +444,16 @@ class UpgradeController:
             return
         cr["status"] = status
         try:
-            self.client.update_custom_object_status(
-                POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
-            )
+            plan = self.write_plan
+            if plan is not None:
+                plan.stage_cr_status(
+                    POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+                )
+                plan.flush_status()
+            else:
+                self.client.update_custom_object_status(
+                    POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+                )
         except Exception as e:  # noqa: BLE001 — best-effort while degraded
             logger.debug("degraded status publication failed: %s", e)
 
@@ -470,6 +485,7 @@ class UpgradeController:
                 for n in group.nodes:
                     node_uids[n.name] = n.metadata.uid
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        plan = self.write_plan
         for (obj, etype, reason, message), count in counts.items():
             involved: dict = {"name": obj, "apiVersion": "v1"}
             if obj in node_uids:
@@ -477,30 +493,34 @@ class UpgradeController:
                 involved["uid"] = node_uids[obj]
             else:
                 involved["kind"] = "Pod"  # restart-failure events name pods
+            event = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                # A real apiserver requires a client-supplied
+                # name (client-go EventRecorder does the same
+                # object.timestamp scheme).
+                "metadata": {"name": f"{obj}.{uuid.uuid4().hex[:12]}"},
+                "involvedObject": involved,
+                "type": etype,
+                "reason": reason,
+                "message": message,
+                "count": count,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "source": {"component": "tpu-upgrade-controller"},
+            }
+            if plan is not None:
+                # Kubelet-style aggregation: identical events within the
+                # window collapse into one count-carrying publication on
+                # the status flow.
+                plan.stage_event(self.config.namespace, event, count)
+                continue
             try:
-                self.client.create_event(
-                    self.config.namespace,
-                    {
-                        "apiVersion": "v1",
-                        "kind": "Event",
-                        # A real apiserver requires a client-supplied
-                        # name (client-go EventRecorder does the same
-                        # object.timestamp scheme).
-                        "metadata": {
-                            "name": f"{obj}.{uuid.uuid4().hex[:12]}"
-                        },
-                        "involvedObject": involved,
-                        "type": etype,
-                        "reason": reason,
-                        "message": message,
-                        "count": count,
-                        "firstTimestamp": now,
-                        "lastTimestamp": now,
-                        "source": {"component": "tpu-upgrade-controller"},
-                    },
-                )
+                self.client.create_event(self.config.namespace, event)
             except Exception as e:  # noqa: BLE001 — telemetry best-effort
                 logger.debug("event publication failed: %s", e)
+        if plan is not None:
+            plan.flush_events()
 
     def _refresh_policy_from_cr(self) -> None:
         """Re-read the TPUUpgradePolicy CR: a policy edit takes effect on
@@ -611,9 +631,19 @@ class UpgradeController:
             if cr.get("status") == status:
                 return  # no churn: don't bump resourceVersion every pass
             cr["status"] = status
-            self.client.update_custom_object_status(
-                POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
-            )
+            plan = self.write_plan
+            if plan is not None:
+                # Status flow: a dry bucket defers to the next pass
+                # (which re-stages the freshest counters); a 409 replays
+                # once onto a fresh read inside the plan.
+                plan.stage_cr_status(
+                    POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+                )
+                plan.flush_status()
+            else:
+                self.client.update_custom_object_status(
+                    POLICY_GROUP, POLICY_VERSION, POLICY_PLURAL, ns, cr
+                )
         except (NotFoundError, ConflictError) as e:
             logger.debug("status update skipped: %s", e)
 

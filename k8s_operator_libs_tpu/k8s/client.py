@@ -560,11 +560,16 @@ class FakeCluster:
         name: str,
         labels: Optional[dict[str, Optional[str]]] = None,
         annotations: Optional[dict[str, Optional[str]]] = None,
+        field_manager: Optional[str] = None,
     ) -> Node:
         """Combined labels+annotations merge patch: ONE API call (one
         stats tick), atomic under the store lock — the coalesced write
-        path batched slice transitions ride."""
+        path batched slice transitions ride.  ``field_manager`` is
+        recorded for test introspection (the fake has no managedFields
+        machinery)."""
         self._call("patch_node")
+        if field_manager is not None:
+            self.last_field_manager = field_manager
         with self._lock:
             node = self._nodes.get_live(name)
             if node is None:

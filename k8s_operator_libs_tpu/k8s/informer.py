@@ -763,10 +763,14 @@ class CachedKubeClient:
         name: str,
         labels: Optional[dict[str, Optional[str]]] = None,
         annotations: Optional[dict[str, Optional[str]]] = None,
+        field_manager: Optional[str] = None,
     ) -> Node:
         return self._echo(
             self._client.patch_node_metadata(
-                name, labels=labels, annotations=annotations
+                name,
+                labels=labels,
+                annotations=annotations,
+                field_manager=field_manager,
             )
         )
 

@@ -78,11 +78,15 @@ class KubeClient(Protocol):
         name: str,
         labels: Optional[dict[str, Optional[str]]] = None,
         annotations: Optional[dict[str, Optional[str]]] = None,
+        field_manager: Optional[str] = None,
     ) -> Node:
         """Combined labels+annotations patch in ONE API round trip (None
         values delete).  The write-coalescing fast path: a slice
         transition that flips the state label and stamps several durable
-        clocks costs one patch per node instead of one per key-group."""
+        clocks costs one patch per node instead of one per key-group.
+        ``field_manager`` names the writer (the server-side-apply idiom)
+        so apiserver audit/conflict attribution sees the write plane as
+        one manager."""
         ...
 
     def set_node_unschedulable(

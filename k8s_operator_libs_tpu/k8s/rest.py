@@ -861,11 +861,14 @@ class RestClient:
         name: str,
         labels: Optional[dict[str, Optional[str]]] = None,
         annotations: Optional[dict[str, Optional[str]]] = None,
+        field_manager: Optional[str] = None,
     ) -> Node:
         # One PATCH carrying both maps; strategic-merge and JSON-merge
         # coincide for flat string maps (null deletes), and the server's
         # node patch handler applies labels and annotations from a single
-        # body (apiserver._patch_node).
+        # body (apiserver._patch_node).  fieldManager attributes the
+        # write plane's coalesced patches to one manager in managedFields
+        # / audit logs (the server-side-apply idiom).
         meta: dict[str, Any] = {}
         if labels:
             meta["labels"] = labels
@@ -875,6 +878,7 @@ class RestClient:
             self._request(
                 "PATCH",
                 f"/api/v1/nodes/{name}",
+                {"fieldManager": field_manager or ""},
                 body={"metadata": meta},
                 content_type=STRATEGIC_MERGE_PATCH,
             )

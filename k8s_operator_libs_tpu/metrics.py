@@ -198,6 +198,71 @@ class UpgradeMetrics:
             "issued during the last reconcile pass — the write-path "
             "hygiene number the coalesced node patches drive down",
         )
+        # Transactional write-plane surface (k8s/writeplan.py): absent
+        # when the manager is an injected fake without a plan.
+        r.describe(
+            "writes_suppressed_total",
+            "Writes skipped because the value already matched the cached "
+            "object (no-op suppression, stage- and flush-time)",
+        )
+        r.describe(
+            "writes_coalesced_total",
+            "Extra key-groups folded into combined per-node metadata "
+            "patches (round trips avoided by coalescing)",
+        )
+        r.describe(
+            "writeplan_writes_total",
+            "API writes issued by the write plane, by flow",
+            "flow",
+        )
+        r.describe(
+            "writeplan_flushes_total",
+            "Write-plan flush batches executed",
+        )
+        r.describe(
+            "writeplan_fenced_drops_total",
+            "Queued write intents dropped whole at flush because the "
+            "liveness or term fence said this process was deposed",
+        )
+        r.describe(
+            "writeplan_conflict_replays_total",
+            "409-conflicted patches replayed through quorum re-read + "
+            "re-fence + re-dedupe (node and CR-status flows)",
+        )
+        r.describe(
+            "writeplan_pending",
+            "Write intents staged but not yet flushed, by kind",
+            "kind",
+        )
+        r.describe(
+            "events_published_total",
+            "Cluster Events actually created by the write plane",
+        )
+        r.describe(
+            "events_aggregated_total",
+            "Event occurrences absorbed into count-carrying aggregates "
+            "instead of separate Event objects (kubelet-style)",
+        )
+        r.describe(
+            "flow_tokens",
+            "Token-bucket level per APF flow",
+            "flow",
+        )
+        r.describe(
+            "flow_throttled",
+            "1 when the flow's bucket is penalized below its base rate "
+            "(429/Retry-After feedback), else 0",
+            "flow",
+        )
+        r.describe(
+            "flow_throttle_waits_total",
+            "Times a mutating write waited on its bucket",
+        )
+        r.describe(
+            "flow_deferred_total",
+            "Status/event writes deferred to the next tick by a dry "
+            "status bucket",
+        )
         r.describe(
             "informer_cache_hits_total",
             "Hot-path reads served from the informer store",
@@ -499,6 +564,46 @@ class UpgradeMetrics:
             if self._last_api_writes is not None:
                 r.set("api_writes_per_tick", writes - self._last_api_writes)
             self._last_api_writes = writes
+        # Transactional write-plane surface (k8s/writeplan.py).
+        plan = getattr(manager, "write_plan", None)
+        if plan is not None and hasattr(plan, "counters"):
+            c = plan.counters()
+            r.set("writes_suppressed_total", c.get("suppressed", 0))
+            r.set("writes_coalesced_total", c.get("coalesced_keys", 0))
+            r.set("writeplan_flushes_total", c.get("flushes", 0))
+            r.set(
+                "writeplan_writes_total",
+                c.get("writes_mutating", 0),
+                flow="mutating",
+            )
+            r.set(
+                "writeplan_writes_total",
+                c.get("writes_status", 0),
+                flow="status",
+            )
+            r.set(
+                "writeplan_fenced_drops_total",
+                c.get("fenced_drops", 0)
+                + c.get("fenced_drops_status", 0)
+                + c.get("fenced_drops_events", 0),
+            )
+            r.set(
+                "writeplan_conflict_replays_total",
+                c.get("conflict_replays", 0)
+                + c.get("status_conflict_replays", 0),
+            )
+            r.set("events_published_total", c.get("events_published", 0))
+            r.set("events_aggregated_total", c.get("events_aggregated", 0))
+            r.set(
+                "flow_throttle_waits_total",
+                c.get("throttle_waits_mutating", 0),
+            )
+            r.set("flow_deferred_total", c.get("deferred_status", 0))
+            for kind, depth in sorted(plan.pending_depth().items()):
+                r.set("writeplan_pending", depth, kind=kind)
+            for flow, fs in sorted(plan.flows.state().items()):
+                r.set("flow_tokens", fs.get("tokens", 0.0), flow=flow)
+                r.set("flow_throttled", fs.get("throttled", 0.0), flow=flow)
         # Heterogeneous-fleet surface.
         preemptions = getattr(manager, "preemptions", None)
         if preemptions is not None:

@@ -70,6 +70,24 @@ BATTERY_METRIC_KEYS = {
 }
 
 
+# Controller /metrics series → status keys for the write-plane section
+# (unlabeled series only; writeplan_writes_total{flow=...},
+# writeplan_pending{kind=...}, flow_tokens{flow=...} and
+# flow_throttled{flow=...} are parsed label-aware below).
+WRITEPLANE_METRIC_KEYS = {
+    "writes_suppressed_total": "suppressed",
+    "writes_coalesced_total": "coalescedKeys",
+    "writeplan_flushes_total": "flushes",
+    "writeplan_fenced_drops_total": "fencedDrops",
+    "writeplan_conflict_replays_total": "conflictReplays",
+    "events_published_total": "eventsPublished",
+    "events_aggregated_total": "eventsAggregated",
+    "flow_throttle_waits_total": "throttleWaits",
+    "flow_deferred_total": "deferred",
+    "api_writes_per_tick": "apiWritesPerTick",
+}
+
+
 def _metrics_text(metrics_url: str, fetch=None) -> str:
     """Fetch the exposition text; ``fetch`` is injectable for tests."""
     if fetch is None:
@@ -199,6 +217,71 @@ def elastic_health(metrics_url: str, fetch=None) -> Optional[dict]:
     if resizes:
         out["resizes"] = resizes
     return out or None
+
+
+def write_plane_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Transactional write-plane health from the controller's /metrics.
+
+    Shows per-flow writes and throttle state, pending queue depths, and
+    the hygiene counters (suppressed / coalesced / aggregated).  Returns
+    None when the write-plane family is absent (controller predates the
+    write plane), an ``{"error": ...}`` dict when the endpoint is
+    unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    writes: dict[str, float] = {}
+    pending: dict[str, float] = {}
+    tokens: dict[str, float] = {}
+    throttled: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "writeplan_writes_total":
+            flow = labels.split('flow="', 1)
+            if len(flow) == 2:
+                writes[flow[1].split('"', 1)[0]] = val
+        elif short == "writeplan_pending":
+            kind = labels.split('kind="', 1)
+            if len(kind) == 2:
+                pending[kind[1].split('"', 1)[0]] = val
+        elif short == "flow_tokens":
+            flow = labels.split('flow="', 1)
+            if len(flow) == 2:
+                tokens[flow[1].split('"', 1)[0]] = val
+        elif short == "flow_throttled":
+            flow = labels.split('flow="', 1)
+            if len(flow) == 2:
+                throttled[flow[1].split('"', 1)[0]] = val
+        else:
+            key = WRITEPLANE_METRIC_KEYS.get(short)
+            if key is not None:
+                out[key] = val
+    if writes:
+        out["writes"] = writes
+    if pending:
+        out["pending"] = pending
+    if tokens:
+        out["flowTokens"] = tokens
+    if throttled:
+        out["flowThrottled"] = throttled
+    # api_writes_per_tick alone predates the write plane — only report a
+    # section when a write-plane-specific series was actually present.
+    plane_only = set(out) - {"apiWritesPerTick"}
+    return out if plane_only else None
 
 
 def gather(
@@ -423,6 +506,9 @@ def gather(
         elastic = elastic_health(metrics_url, fetch=metrics_fetch)
         if elastic is not None:
             out["elasticCoordination"] = elastic
+        plane = write_plane_health(metrics_url, fetch=metrics_fetch)
+        if plane is not None:
+            out["writePlane"] = plane
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -618,6 +704,45 @@ def render(status: dict) -> str:
                 f"up {int(res.get('up', 0))} "
                 f"(last {elastic.get('lastResizeSeconds', 0.0):.1f}s)"
             )
+    plane = status.get("writePlane")
+    if plane is not None:
+        lines.append("")
+        if "error" in plane:
+            lines.append(f"write plane: {plane['error']}")
+        else:
+            writes = plane.get("writes") or {}
+            pending = plane.get("pending") or {}
+            tokens = plane.get("flowTokens") or {}
+            throttled = plane.get("flowThrottled") or {}
+            flow_bits = []
+            for flow in ("mutating", "status"):
+                state = "THROTTLED" if throttled.get(flow) else "ok"
+                flow_bits.append(
+                    f"{flow} {int(writes.get(flow, 0))} write(s) "
+                    f"({tokens.get(flow, 0.0):.0f} tokens, {state})"
+                )
+            lines.append("write plane: " + " | ".join(flow_bits))
+            lines.append(
+                f"  queued: "
+                + ", ".join(
+                    f"{kind}={int(n)}" for kind, n in sorted(pending.items())
+                )
+                + f" | last tick {int(plane.get('apiWritesPerTick', 0))} "
+                f"api write(s)"
+            )
+            lines.append(
+                f"  hygiene: {int(plane.get('suppressed', 0))} suppressed, "
+                f"{int(plane.get('coalescedKeys', 0))} coalesced key(s), "
+                f"{int(plane.get('eventsAggregated', 0))} event(s) "
+                f"aggregated into {int(plane.get('eventsPublished', 0))} "
+                f"published"
+            )
+            lines.append(
+                f"  safety: {int(plane.get('fencedDrops', 0))} fenced "
+                f"drop(s), {int(plane.get('conflictReplays', 0))} conflict "
+                f"replay(s), {int(plane.get('deferred', 0))} deferred, "
+                f"{int(plane.get('throttleWaits', 0))} throttle wait(s)"
+            )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")
@@ -653,7 +778,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--metrics-url",
         default="",
         help="controller /metrics endpoint (e.g. http://HOST:9090/metrics);"
-        " adds the sharded-reconcile shard-health section",
+        " adds the sharded-reconcile and write-plane health sections",
     )
     parser.add_argument("--json", action="store_true", dest="as_json")
     args = parser.parse_args(argv)

@@ -73,9 +73,17 @@ class AnnotationRungStore:
     leader already committed to).
     """
 
-    def __init__(self, client: KubeClient, keys: UpgradeKeys) -> None:
+    def __init__(
+        self, client: KubeClient, keys: UpgradeKeys, plan=None
+    ) -> None:
         self.client = client
         self.keys = keys
+        # Optional write plane (k8s/writeplan.py): when wired, rung
+        # clocks stage as plan intents — worker-thread durable-clock
+        # patches get the same coalescing, no-op suppression, flow
+        # control, and fence-at-flush as engine writes instead of
+        # bypassing them with raw patches.
+        self.plan = plan
 
     def load(self, node_name: str) -> Optional[tuple[str, int]]:
         try:
@@ -90,9 +98,15 @@ class AnnotationRungStore:
             return None
         return rung, since
 
+    def _write(self, node_name: str, patch: dict) -> None:
+        if self.plan is not None:
+            self.plan.write_node(node_name, annotations=patch)
+        else:
+            self.client.patch_node_annotations(node_name, patch)
+
     def save(self, node_name: str, rung: str, epoch: int) -> None:
         try:
-            self.client.patch_node_annotations(
+            self._write(
                 node_name,
                 {
                     self.keys.eviction_rung_annotation: rung,
@@ -104,7 +118,7 @@ class AnnotationRungStore:
 
     def clear(self, node_name: str) -> None:
         try:
-            self.client.patch_node_annotations(
+            self._write(
                 node_name,
                 {
                     self.keys.eviction_rung_annotation: None,

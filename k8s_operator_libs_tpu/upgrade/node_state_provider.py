@@ -8,18 +8,27 @@ write is visible** — the trick that makes the stateless reconcile loop safe
 when the controller cache lags the apiserver
 (node_upgrade_state_provider.go:92-99).
 
-TPU redesign on top of parity: **batched group transitions**.  The
-reference pays (patch + up-to-10s poll) serially per node; on a 16-host
-v5p-64 slice that alone eats the <2 min downtime budget (SURVEY.md §7
-'hard parts').  ``change_nodes_upgrade_state`` issues all patches
-concurrently and then polls all nodes concurrently, so a whole slice's
-label flip costs one round-trip + one cache-sync wait, not N.
+TPU redesign on top of parity: **batched group transitions** riding the
+transactional write plane (``k8s/writeplan.py``).  The reference pays
+(patch + up-to-10s poll) serially per node; on a 16-host v5p-64 slice
+that alone eats the <2 min downtime budget (SURVEY.md §7 'hard parts').
+``change_nodes_upgrade_state`` issues all patches concurrently and then
+polls all nodes concurrently, so a whole slice's label flip costs one
+round-trip + one cache-sync wait, not N.
+
+Every write is an *intent* staged into the shared, thread-safe
+:class:`~k8s_operator_libs_tpu.k8s.writeplan.WritePlan` (which replaced
+the old thread-local ``_WriteBatch``): the engine pass coalesces inside
+``batched()`` scopes while drain/probe/validation worker threads flush
+standalone intents through the same dedupe / fence / flow-control /
+409-replay path, so their durable-clock patches coalesce too.  Writes
+whose value already matches the cached object are suppressed at stage
+time and counted in ``writes_suppressed_total``.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Optional, Sequence
 
@@ -27,6 +36,7 @@ from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.client import NotFoundError
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import Node
+from k8s_operator_libs_tpu.k8s.writeplan import NodeIntent, WritePlan
 from k8s_operator_libs_tpu.upgrade.consts import NULL_STRING, UpgradeState
 from k8s_operator_libs_tpu.upgrade.util import (
     EVENT_TYPE_NORMAL,
@@ -43,22 +53,6 @@ logger = get_logger(__name__)
 
 class CacheSyncTimeout(RuntimeError):
     """The written value never became visible in the read cache."""
-
-
-class _WriteBatch:
-    """Per-node pending label/annotation patches for one coalesced flush.
-
-    Values are PATCH values: None = delete.  ``nodes`` keeps the caller's
-    Node object per name so the flush's visibility wait can refresh it.
-    """
-
-    def __init__(self) -> None:
-        self.labels: dict[str, dict[str, Optional[str]]] = {}
-        self.annotations: dict[str, dict[str, Optional[str]]] = {}
-        self.nodes: dict[str, Node] = {}
-
-    def names(self) -> list[str]:
-        return sorted(set(self.labels) | set(self.annotations))
 
 
 def node_ready(node: Node) -> bool:
@@ -84,6 +78,7 @@ class NodeUpgradeStateProvider:
         poll_timeout_s: float = 10.0,
         max_concurrency: int = 32,
         max_staleness_s: float = 30.0,
+        plan: Optional[WritePlan] = None,
     ) -> None:
         # Reference defaults: 1 s poll, 10 s timeout
         # (node_upgrade_state_provider.go:100-103).
@@ -101,76 +96,71 @@ class NodeUpgradeStateProvider:
         # convergence polls and the whole point is to read the cache.
         self.max_staleness_s = max_staleness_s
         self._node_mutex = KeyedMutex()
-        # Active write-coalescing batch, per thread: the engine's pass
-        # thread batches while drain/probe workers keep writing through
-        # directly.
-        self._batch_local = threading.local()
+        # All writes route through the shared write plane: coalescing,
+        # no-op suppression, flow control, fence-at-flush, 409 replay.
+        self.plan = plan or WritePlan(
+            client, max_concurrency=max_concurrency
+        )
 
     # -- write coalescing ----------------------------------------------------
 
-    def _active_batch(self) -> Optional[_WriteBatch]:
-        return getattr(self._batch_local, "batch", None)
-
     @contextlib.contextmanager
     def batched(self):
-        """Coalesce this thread's node writes into one patch per node.
+        """Coalesce node writes into one patch per node via the write
+        plan.
 
         Inside the context, ``change_node(s)_upgrade_state`` /
         ``change_node(s)_upgrade_annotation`` apply their mutation to the
         caller's Node objects immediately (read-your-writes within the
-        pass) and defer the API write; on exit every node gets a single
-        combined labels+annotations patch (``patch_node_metadata``) and
-        one cache-sync wait.  A transition that today costs a label
-        patch plus N annotation round trips per node collapses to one.
+        pass) and stage the API write as a plan intent; on exit every
+        node gets a single combined labels+annotations patch
+        (``patch_node_metadata``) and one cache-sync wait.  A transition
+        that today costs a label patch plus N annotation round trips per
+        node collapses to one.
 
-        Nested use joins the outer batch.  The batch is thread-local, so
-        concurrently-running workers are unaffected.
+        Nested use joins the outer scope.  Scopes are per-thread over
+        the shared plan, so concurrently-running workers stage into the
+        same plan without cross-flushing each other's scopes.  If the
+        body raises, this scope's staged intents are discarded (the old
+        batch-drop semantics) — the next idempotent pass re-drives them.
         """
-        if self._active_batch() is not None:
+        scope = self.plan.begin_scope()
+        if scope is None:
             yield self
             return
-        batch = _WriteBatch()
-        self._batch_local.batch = batch
+        ok = False
         try:
             yield self
+            ok = True
         finally:
-            self._batch_local.batch = None
-        self._flush_batch(batch)
+            names = self.plan.end_scope(scope)
+            if not ok:
+                self.plan.discard(names)
+        self._flush_names(names)
 
-    def _flush_batch(self, batch: _WriteBatch) -> None:
-        names = batch.names()
+    def _flush_names(self, names: list[str]) -> None:
         if not names:
             return
-        run_batch(
-            [(lambda n=n: self._flush_node(batch, n)) for n in names],
-            self.max_concurrency,
-        )
 
-    def _flush_node(self, batch: _WriteBatch, name: str) -> None:
-        labels = batch.labels.get(name)
-        annotations = batch.annotations.get(name)
-        with self._node_mutex.lock(name):
-            try:
-                combined = getattr(self.client, "patch_node_metadata", None)
-                if combined is not None:
-                    combined(name, labels=labels, annotations=annotations)
-                else:  # client predates the combined patch: two writes
-                    if labels:
-                        self.client.patch_node_labels(name, labels)
-                    if annotations:
-                        self.client.patch_node_annotations(name, annotations)
-            except Exception:
-                log_event(
-                    self.event_recorder,
-                    name,
-                    EVENT_TYPE_WARNING,
-                    self.keys.event_reason,
-                    "Failed to apply coalesced node metadata patch",
+        def _post(intent: NodeIntent, fresh: Node) -> None:
+            node = intent.node
+            if node is None:
+                return
+            with self._node_mutex.lock(intent.name):
+                self._wait_metadata_visible(
+                    node, intent.labels, intent.annotations
                 )
-                raise
-            self._wait_metadata_visible(
-                batch.nodes[name], labels or {}, annotations or {}
+
+        def _on_error(intent: NodeIntent, exc: Exception) -> None:
+            log_event(
+                self.event_recorder,
+                intent.name,
+                EVENT_TYPE_WARNING,
+                self.keys.event_reason,
+                "Failed to apply coalesced node metadata patch",
             )
+
+        self.plan.flush_nodes(names, post=_post, on_error=_on_error)
 
     def _wait_metadata_visible(
         self,
@@ -226,21 +216,42 @@ class NodeUpgradeStateProvider:
 
     def change_node_upgrade_state(self, node: Node, new_state: UpgradeState) -> None:
         """Patch the state label and wait until the cache shows it."""
-        batch = self._active_batch()
-        if batch is not None:
-            value = (
-                new_state.value if new_state != UpgradeState.UNKNOWN else None
-            )
-            batch.labels.setdefault(node.name, {})[self.keys.state_label] = value
-            batch.nodes[node.name] = node
-            if value is None:
-                node.metadata.labels.pop(self.keys.state_label, None)
-            else:
-                node.metadata.labels[self.keys.state_label] = value
+        # UNKNOWN means "label absent": a strategic-merge delete.
+        value = new_state.value if new_state != UpgradeState.UNKNOWN else None
+        key = self.keys.state_label
+        current = node.metadata.labels.get(key)
+        if (value is None and key not in node.metadata.labels) or (
+            value is not None and current == value
+        ):
+            # No-op against the cached object: suppress the round trip.
+            self.plan.note_suppressed()
             return
+        if self.plan.in_scope():
+            # Scoped: stage the intent and apply to the caller's object
+            # immediately (read-your-writes within the pass); the API
+            # write lands at scope exit.
+            self.plan.stage(node.name, labels={key: value}, node=node)
+            if value is None:
+                node.metadata.labels.pop(key, None)
+            else:
+                node.metadata.labels[key] = value
+            return
+        intent = self.plan.stage(node.name, labels={key: value}, node=node)
         with self._node_mutex.lock(node.name):
-            self._patch_state(node.name, new_state)
-            self._wait_label_visible(node, self.keys.state_label, new_state.value)
+            try:
+                flushed = self.plan.flush_intent(intent)
+            except Exception:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    f"Failed to update node state label to {new_state.value}",
+                )
+                raise
+            if flushed is None:
+                return  # suppressed against the snapshot, or fenced
+            self._wait_label_visible(node, key, new_state.value)
 
     def change_node_upgrade_annotation(
         self, node: Node, key: str, value: str
@@ -248,17 +259,38 @@ class NodeUpgradeStateProvider:
         """Patch an annotation; ``value == "null"`` deletes it
         (node_upgrade_state_provider.go:147-150)."""
         patch_value = None if value == NULL_STRING else value
-        batch = self._active_batch()
-        if batch is not None:
-            batch.annotations.setdefault(node.name, {})[key] = patch_value
-            batch.nodes[node.name] = node
+        current = node.metadata.annotations.get(key)
+        if (
+            patch_value is None and key not in node.metadata.annotations
+        ) or (patch_value is not None and current == patch_value):
+            self.plan.note_suppressed()
+            return
+        if self.plan.in_scope():
+            self.plan.stage(
+                node.name, annotations={key: patch_value}, node=node
+            )
             if patch_value is None:
                 node.metadata.annotations.pop(key, None)
             else:
                 node.metadata.annotations[key] = patch_value
             return
+        intent = self.plan.stage(
+            node.name, annotations={key: patch_value}, node=node
+        )
         with self._node_mutex.lock(node.name):
-            self.client.patch_node_annotations(node.name, {key: patch_value})
+            try:
+                flushed = self.plan.flush_intent(intent)
+            except Exception:
+                log_event(
+                    self.event_recorder,
+                    node.name,
+                    EVENT_TYPE_WARNING,
+                    self.keys.event_reason,
+                    f"Failed to update node annotation {key}={value}",
+                )
+                raise
+            if flushed is None:
+                return
             self._wait_annotation_visible(node, key, value)
 
     # -- batched group writes (TPU-native fast path) -------------------------
@@ -272,10 +304,11 @@ class NodeUpgradeStateProvider:
         Raises on the first failure after all attempts complete, so a
         partially-written slice is re-driven by the next idempotent pass
         (the group's effective_state resolves to the earliest member)."""
-        if self._active_batch() is not None:
-            # The coalescing batch is thread-local: fanning out to worker
-            # threads would bypass it, so apply in-line (recording into a
-            # batch is cheap — the round trips happen at flush).
+        if self.plan.in_scope():
+            # Inside a coalescing scope: fanning out to worker threads
+            # would leave this thread's scope behind, so stage in-line
+            # (recording an intent is cheap — round trips happen at
+            # flush).
             for n in nodes:
                 self.change_node_upgrade_state(n, new_state)
             return
@@ -290,7 +323,7 @@ class NodeUpgradeStateProvider:
     def change_nodes_upgrade_annotation(
         self, nodes: Sequence[Node], key: str, value: str
     ) -> None:
-        if self._active_batch() is not None:
+        if self.plan.in_scope():
             for n in nodes:
                 self.change_node_upgrade_annotation(n, key, value)
             return
@@ -303,21 +336,6 @@ class NodeUpgradeStateProvider:
         )
 
     # -- internals ----------------------------------------------------------
-
-    def _patch_state(self, node_name: str, new_state: UpgradeState) -> None:
-        # UNKNOWN means "label absent": a strategic-merge delete.
-        value = new_state.value if new_state != UpgradeState.UNKNOWN else None
-        try:
-            self.client.patch_node_labels(node_name, {self.keys.state_label: value})
-        except Exception:
-            log_event(
-                self.event_recorder,
-                node_name,
-                EVENT_TYPE_WARNING,
-                self.keys.event_reason,
-                f"Failed to update node state label to {new_state.value}",
-            )
-            raise
 
     def _wait_label_visible(
         self, node: Node, label_key: str, expected: str

@@ -795,6 +795,24 @@ class ShardedReconciler:
             else:
                 report.pools_walked += 1
         report.incomplete = len(pending)
+        # Safety net: a cleanly-exited coalescing scope leaves no pending
+        # node intents in the write plan.  Flush (fence-checked inside
+        # the plan) anything a crashed shard leaked so it cannot ride
+        # into a later, unrelated scope's flush — and so the leak is
+        # visible in stats instead of silent.
+        plan = getattr(self.manager, "write_plan", None)
+        if (
+            plan is not None
+            and not pending  # no shard still mid-scope past the wait
+            and not self._outstanding
+            and plan.pending_depth().get("nodes")
+        ):
+            try:
+                leaked = plan.flush_nodes()
+                if leaked:
+                    self.stats["plan_leaked_intents"] += len(leaked)
+            except Exception as e:  # noqa: BLE001 — best-effort sweep
+                logger.warning("leaked write-plan intent flush failed: %s", e)
         report.queue_depth_after = self.queue.depth()
         report.duration_s = time.monotonic() - t0
         return report

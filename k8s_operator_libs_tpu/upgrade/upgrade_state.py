@@ -42,6 +42,7 @@ from k8s_operator_libs_tpu.k8s.drain import (
 )
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
 from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
+from k8s_operator_libs_tpu.k8s.writeplan import WritePlan
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
     ELASTIC_RESPONSE_ACCEPT,
@@ -138,6 +139,15 @@ class ClusterUpgradeStateManager:
             poll_interval_s=poll_interval_s,
             poll_timeout_s=poll_timeout_s,
         )
+        # The transactional write plane every producer stages into
+        # (k8s/writeplan.py): the provider owns it; the manager re-exports
+        # it so the controller (CR status, Events), the durable rung
+        # store, and metrics all share one plan — one coalesced patch per
+        # node per tick, flow-scheduled status traffic, fence-at-flush.
+        # Injected fake providers (tests) may not carry one; give them a
+        # standalone plan so downstream wiring stays uniform.
+        plan = getattr(self.provider, "plan", None)
+        self.write_plan = plan if plan is not None else WritePlan(client)
         self.cordon_manager = cordon_manager or CordonManager(client)
         # Eviction/deletion polling is a distinct cadence from the
         # provider's cache-sync polls; it follows poll_interval_s by
@@ -223,7 +233,9 @@ class ClusterUpgradeStateManager:
         # entry epoch persisted as annotations, shared into every
         # DrainHelper owner the same way as escalation_stats so a fresh
         # leader resumes each ladder AT its committed rung, never rung 0.
-        self.rung_store = AnnotationRungStore(client, self.keys)
+        self.rung_store = AnnotationRungStore(
+            client, self.keys, plan=self.write_plan
+        )
         for mgr in (
             self.drain_manager,
             self.pod_manager,
@@ -331,6 +343,9 @@ class ClusterUpgradeStateManager:
     @fence.setter
     def fence(self, fn) -> None:
         self._fence = fn
+        # The write plane checks liveness at FLUSH time so a deposed
+        # leader's queued plan is dropped whole, never partially applied.
+        self.write_plan.fence = fn
         for mgr in (
             self.drain_manager,
             self.pod_manager,
@@ -352,6 +367,9 @@ class ClusterUpgradeStateManager:
     @term_fence.setter
     def term_fence(self, fn) -> None:
         self._term_fence = fn
+        # Flush-time term check on a bounded sample of the staged nodes:
+        # closes the deposed-leader window the liveness fence cannot.
+        self.write_plan.term_fence = fn
         for mgr in (
             self.drain_manager,
             self.pod_manager,
@@ -2241,7 +2259,19 @@ class ClusterUpgradeStateManager:
         cap = policy.max_unavailable.scaled_value(
             self._total_units(state, unit)
         )
-        return self._unavailable_units(state, unit) + charge <= cap
+        # Mirror the admission math: units about to be cordoned (still
+        # labeled cordon-required, hosts not yet unschedulable) hold a
+        # slot too.  Without this, a slice healing the same pass its
+        # freed budget was re-spent rejoins past slices that were
+        # admitted but not yet cordoned, and the pass then cordons all
+        # of them — busting maxUnavailable.
+        if unit == "slice":
+            pending = len(state.groups_in(UpgradeState.CORDON_REQUIRED))
+        else:
+            pending = len(state.nodes_in(UpgradeState.CORDON_REQUIRED))
+        return (
+            self._unavailable_units(state, unit) + pending + charge <= cap
+        )
 
     # -- shared helpers ------------------------------------------------------
 

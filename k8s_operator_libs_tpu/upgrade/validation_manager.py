@@ -212,16 +212,21 @@ class ValidationManager:
         attempts = max(attempts, self.rollback_attempts.get(group.id, 0)) + 1
         self.rollback_attempts[group.id] = attempts
         try:
-            self.provider.change_nodes_upgrade_annotation(
-                group.nodes,
-                self.keys.rollback_attempts_annotation,
-                str(attempts),
-            )
-            self.provider.change_nodes_upgrade_annotation(
-                group.nodes,
-                self.keys.rollback_last_attempt_annotation,
-                str(int(time.time())),
-            )
+            # One coalesced metadata patch per node (attempts + last-
+            # attempt epoch together) — this runs on rollback worker
+            # threads, which the thread-safe write plan now coalesces
+            # just like the engine pass.
+            with self.provider.batched():
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes,
+                    self.keys.rollback_attempts_annotation,
+                    str(attempts),
+                )
+                self.provider.change_nodes_upgrade_annotation(
+                    group.nodes,
+                    self.keys.rollback_last_attempt_annotation,
+                    str(int(time.time())),
+                )
         except Exception as e:  # noqa: BLE001 — best-effort persistence
             logger.warning(
                 "failed to persist rollback clock for group %s: %s",
@@ -542,19 +547,23 @@ class ValidationManager:
                     # work (best-effort; re-adopting a finished eviction
                     # is idempotent anyway).
                     try:
-                        for key in (
-                            self.keys.rollback_attempts_annotation,
-                            self.keys.rollback_last_attempt_annotation,
-                        ):
-                            self.provider.change_nodes_upgrade_annotation(
-                                [
-                                    n
-                                    for n in group.nodes
-                                    if key in n.annotations
-                                ],
-                                key,
-                                "null",
-                            )
+                        # Both clock deletes coalesce into one metadata
+                        # patch per node via the write plan (this runs on
+                        # a rollback worker thread).
+                        with self.provider.batched():
+                            for key in (
+                                self.keys.rollback_attempts_annotation,
+                                self.keys.rollback_last_attempt_annotation,
+                            ):
+                                self.provider.change_nodes_upgrade_annotation(
+                                    [
+                                        n
+                                        for n in group.nodes
+                                        if key in n.annotations
+                                    ],
+                                    key,
+                                    "null",
+                                )
                     except Exception as e:  # noqa: BLE001
                         logger.warning(
                             "failed to clear rollback clocks for group "

@@ -60,6 +60,9 @@ def _bench_shaped_summary() -> dict:
         "sharded_idle_pools_walked": 0,
         "sharded_idle_p99_tick_s": 0.000123,
         "sharded_active_pools_walked": 1,
+        "write_hygiene_writes_per_transition": 1.429,
+        "write_hygiene_idle_writes": 0,
+        "write_hygiene_event_collapse": 25.0,
         "fused_battery_warm_s": 0.123,
         "fused_battery_cache_hit": True,
         "fused_battery_fallbacks": 0,

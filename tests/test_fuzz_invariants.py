@@ -385,10 +385,13 @@ def test_random_crash_points_hold_invariants(seed):
 
     # STATE_ORDER regression guard, checked at the patch site: backward
     # movement is legal only out of FAILED/QUARANTINED (order >= 100).
+    # Both label entry points are hooked — the write plane coalesces
+    # state transitions into patch_node_metadata.
     regressions: list[tuple[str, str, str]] = []
     orig_patch = cluster.patch_node_labels
+    orig_metadata = cluster.patch_node_metadata
 
-    def guarded_patch(name, patch):
+    def _check_regression(name, patch):
         if keys.state_label in patch:
             old = parse_state(
                 cluster.get_node(name, cached=False).labels.get(
@@ -401,9 +404,19 @@ def test_random_crash_points_hold_invariants(seed):
                 and STATE_ORDER[old] < 100
             ) or (old is UpgradeState.DONE and new is not UpgradeState.DONE):
                 regressions.append((name, old.value, new.value))
+
+    def guarded_patch(name, patch):
+        _check_regression(name, patch)
         return orig_patch(name, patch)
 
+    def guarded_metadata(name, labels=None, annotations=None, **kw):
+        _check_regression(name, labels or {})
+        return orig_metadata(
+            name, labels=labels, annotations=annotations, **kw
+        )
+
     cluster.patch_node_labels = guarded_patch
+    cluster.patch_node_metadata = guarded_metadata
 
     # Force-delete ledger, tagged with the leader term that issued it.
     term_box = {"term": 1}
@@ -891,13 +904,24 @@ def test_random_heterogeneous_pools_hold_budget_and_window_invariants(seed):
     }
     held_transitions: list = []
     orig_patch = cluster.patch_node_labels
+    orig_metadata = cluster.patch_node_metadata
 
-    def watch_patch(name, patch):
+    def _watch(name, patch):
         if keys.state_label in patch and name in held_nodes:
             held_transitions.append((name, patch[keys.state_label]))
+
+    def watch_patch(name, patch):
+        _watch(name, patch)
         return orig_patch(name, patch)
 
+    def watch_metadata(name, labels=None, annotations=None, **kw):
+        _watch(name, labels or {})
+        return orig_metadata(
+            name, labels=labels, annotations=annotations, **kw
+        )
+
     cluster.patch_node_labels = watch_patch
+    cluster.patch_node_metadata = watch_metadata
 
     def slice_cordoned(sname):
         return any(

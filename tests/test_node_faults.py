@@ -293,6 +293,82 @@ class TestSliceQuarantine:
             == UpgradeState.UPGRADE_REQUIRED.value
         )
 
+    def test_rejoin_counts_pending_cordons_toward_budget(self):
+        # A healed slice must NOT rejoin past slices that were admitted
+        # but not yet cordoned: cordon-required groups hold a slot in
+        # the rejoin check exactly as they do in the admission math,
+        # else the same pass cordons all of them and busts
+        # maxUnavailable (the fuzz seed-1 over-budget scenario).
+        c = FakeCluster()
+        fx = ClusterFixture(c)
+        ds = fx.daemon_set()
+        a_nodes = fx.tpu_slice(
+            "slice-a", hosts=2, state=UpgradeState.QUARANTINED
+        )
+        b_nodes = fx.tpu_slice(
+            "slice-b", hosts=2, state=UpgradeState.CORDON_REQUIRED
+        )
+        c_nodes = fx.tpu_slice(
+            "slice-c", hosts=2, state=UpgradeState.CORDON_REQUIRED
+        )
+        fx.bump_daemon_set_template(ds, "hash-2", 2)
+        for n in a_nodes + b_nodes + c_nodes:
+            fx.driver_pod(n, ds)
+        # Slice A is healthy again, dwell long since passed, parked from
+        # cordon-required (hosts never cordoned, like a park that hit
+        # before the cordon landed).
+        for n in a_nodes:
+            c.patch_node_annotations(
+                n.name,
+                {
+                    KEYS.quarantine_prior_state_annotation: (
+                        UpgradeState.CORDON_REQUIRED.value
+                    ),
+                    KEYS.quarantine_ready_since_annotation: str(
+                        int(time.time()) - 300
+                    ),
+                },
+            )
+        mgr = make_manager(c)
+        policy = tpu_policy(
+            unavailability_unit="slice",
+            max_unavailable=IntOrString(2),
+            slice_quarantine=quarantine_spec(dwell_s=0),
+        )
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.wait_for_async_work()
+        # B and C (pending cordons) fill the budget: A stays parked.
+        assert (
+            state_of(c, KEYS, a_nodes[0].name)
+            == UpgradeState.QUARANTINED.value
+        )
+        assert "awaiting unavailability budget" in mgr.quarantine_reasons[
+            "slice-a"
+        ]
+        cordoned_slices = sum(
+            1
+            for nodes in (a_nodes, b_nodes, c_nodes)
+            if any(
+                c.get_node(n.name, cached=False).spec.unschedulable
+                for n in nodes
+            )
+        )
+        assert cordoned_slices <= 2
+        # Once a slot frees (B completes), the next pass rejoins A.
+        for n in b_nodes:
+            c.patch_node_labels(
+                n.name,
+                {KEYS.state_label: UpgradeState.DONE.value},
+            )
+            c.set_node_unschedulable(n.name, False)
+        mgr.apply_state(build(mgr, policy), policy)
+        mgr.wait_for_async_work()
+        assert (
+            state_of(c, KEYS, a_nodes[0].name)
+            != UpgradeState.QUARANTINED.value
+        )
+        assert mgr.rejoins_total == 1
+
     def test_rejoin_resumes_prior_state_after_dwell(self):
         c = FakeCluster()
         fx, ds, nodes = _sliced_cluster(

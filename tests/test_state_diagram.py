@@ -60,16 +60,21 @@ def test_generated_diagram_is_current():
 
 
 class _TransitionRecorder:
-    """Wraps FakeCluster.patch_node_labels to record (from, to) edges."""
+    """Wraps FakeCluster's node label patch verbs to record (from, to)
+    edges.  State labels ride the write plane's combined metadata patch
+    (patch_node_metadata); the bare label patch is kept hooked for
+    completeness."""
 
     def __init__(self, cluster, keys):
         self.cluster = cluster
         self.keys = keys
         self.observed: set[tuple[UpgradeState, UpgradeState]] = set()
-        self._orig = cluster.patch_node_labels
-        cluster.patch_node_labels = self._wrapped
+        self._orig_labels = cluster.patch_node_labels
+        self._orig_metadata = cluster.patch_node_metadata
+        cluster.patch_node_labels = self._wrapped_labels
+        cluster.patch_node_metadata = self._wrapped_metadata
 
-    def _wrapped(self, name, patch):
+    def _record(self, name, patch):
         if self.keys.state_label in patch:
             old = parse_state(
                 self.cluster.get_node(name, cached=False).labels.get(
@@ -79,7 +84,21 @@ class _TransitionRecorder:
             new = parse_state(patch[self.keys.state_label] or "")
             if old != new:
                 self.observed.add((old, new))
-        return self._orig(name, patch)
+
+    def _wrapped_labels(self, name, patch):
+        self._record(name, patch)
+        return self._orig_labels(name, patch)
+
+    def _wrapped_metadata(
+        self, name, labels=None, annotations=None, field_manager=None
+    ):
+        self._record(name, labels or {})
+        return self._orig_metadata(
+            name,
+            labels=labels,
+            annotations=annotations,
+            field_manager=field_manager,
+        )
 
 
 def _run(mgr, cluster, keys, nodes, policy, want, max_ticks=60):

@@ -1,0 +1,309 @@
+"""Transactional write-plane contract (k8s/writeplan.py): 409-conflict
+replay per the retry taxonomy (conflicts re-read, they never blind-
+retry), fence-at-flush (a deposed leader's queued plan drops whole),
+APF-style flow isolation (status saturation never delays a mutating
+write), stage-time no-op suppression, and kubelet-style event
+aggregation."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.faults import FaultSchedule
+from k8s_operator_libs_tpu.k8s.writeplan import (
+    FLOW_MUTATING,
+    FLOW_STATUS,
+    FlowScheduler,
+    WritePlan,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys, UpgradeState
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import make_node
+
+KEYS = UpgradeKeys()
+
+
+class _Clock:
+    """Controllable monotonic clock for deterministic bucket tests."""
+
+    def __init__(self) -> None:
+        self.now = 1000.0
+        self.sleeps: list[float] = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.now += s
+
+
+# -- 409 conflict replay ---------------------------------------------------
+
+
+def test_conflict_replay_rereads_and_reapplies():
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    cluster.fault_schedule = FaultSchedule().conflict(
+        "patch_node", max_hits=1
+    )
+    plan = WritePlan(cluster)
+    intent = plan.stage(
+        "n0",
+        labels={"roll/state": "cordon-required"},
+        annotations={"roll/clock": "3"},
+    )
+    fresh = plan.flush_intent(intent)
+    assert fresh is not None
+    node = cluster.get_node("n0", cached=False)
+    assert node.metadata.labels["roll/state"] == "cordon-required"
+    assert node.metadata.annotations["roll/clock"] == "3"
+    c = plan.counters()
+    assert c["conflict_replays"] == 1
+    # First attempt 409s, the replay re-reads with quorum and re-applies
+    # exactly once: two patch calls on the wire, ONE successful write.
+    assert cluster.stats["patch_node"] == 2
+    assert c["writes"] == 1
+
+
+def test_conflict_replay_dedupes_against_fresh_read():
+    # The conflicting writer already applied our value: the replay's
+    # quorum re-read must swallow the delta instead of re-writing it.
+    cluster = FakeCluster()
+    node = make_node("n0", labels={"roll/state": "cordon-required"})
+    cluster.create_node(node)
+    cluster.fault_schedule = FaultSchedule().conflict(
+        "patch_node", max_hits=1
+    )
+    plan = WritePlan(cluster)
+    intent = plan.stage("n0", labels={"roll/state": "cordon-required"})
+    fresh = plan.flush_intent(intent)
+    # Satisfied without a second write: the fresh read already carries
+    # the value, so the replay returns it instead of re-patching.
+    assert fresh is not None
+    assert fresh.metadata.labels["roll/state"] == "cordon-required"
+    c = plan.counters()
+    assert c["conflict_replays"] == 1
+    assert c.get("writes", 0) == 0
+    assert c["suppressed"] >= 1
+    assert cluster.stats["patch_node"] == 1  # only the 409'd attempt
+
+
+def test_conflict_replay_respects_term_fence():
+    # A higher-term adoption stamp discovered on the quorum re-read
+    # means a new leader owns the node: the replay must drop, not write.
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    cluster.fault_schedule = FaultSchedule().conflict(
+        "patch_node", max_hits=1
+    )
+    plan = WritePlan(cluster, term_fence=lambda nodes: False)
+    intent = plan.stage("n0", labels={"roll/state": "drain-required"})
+    assert plan.flush_intent(intent) is None
+    node = cluster.get_node("n0", cached=False)
+    assert "roll/state" not in node.metadata.labels
+    c = plan.counters()
+    assert c["conflict_replays"] == 1
+    assert c["fenced_drops"] == 1
+    assert c.get("writes", 0) == 0
+
+
+def test_second_conflict_is_fatal():
+    # The taxonomy pins ConflictError as fatal to blind retries: the
+    # plan replays exactly once, a second 409 propagates.
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    cluster.fault_schedule = FaultSchedule().conflict(
+        "patch_node", max_hits=2
+    )
+    plan = WritePlan(cluster)
+    intent = plan.stage("n0", labels={"roll/state": "drain-required"})
+    from k8s_operator_libs_tpu.k8s.client import ConflictError
+
+    with pytest.raises(ConflictError):
+        plan.flush_intent(intent)
+    assert cluster.stats["patch_node"] == 2
+
+
+# -- fence at flush --------------------------------------------------------
+
+
+def test_deposed_leader_flush_drops_whole_plan():
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    cluster.create_node(make_node("n1"))
+    plan = WritePlan(cluster)
+    scope = plan.begin_scope()
+    plan.stage("n0", labels={"roll/state": "cordon-required"})
+    plan.stage("n1", annotations={"roll/clock": "7"})
+    names = plan.end_scope(scope)
+    assert plan.pending_depth()["nodes"] == 2
+    # Deposed between staging and flush: the WHOLE queued plan drops —
+    # no partial application, no API writes.
+    plan.fence = lambda: False
+    assert plan.flush_nodes(names) == []
+    assert plan.pending_depth()["nodes"] == 0
+    assert cluster.stats.get("patch_node", 0) == 0
+    assert plan.counters()["fenced_drops"] == 2
+    for name in ("n0", "n1"):
+        node = cluster.get_node(name, cached=False)
+        assert "roll/state" not in node.metadata.labels
+        assert "roll/clock" not in node.metadata.annotations
+
+
+def test_standalone_intent_fence_checked_at_flush():
+    # Worker-thread (unscoped) writes go through the same fence.
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    plan = WritePlan(cluster, fence=lambda: False)
+    intent = plan.stage("n0", annotations={"roll/backoff": "2"})
+    assert plan.flush_intent(intent) is None
+    assert cluster.stats.get("patch_node", 0) == 0
+    assert plan.counters()["fenced_drops"] == 1
+
+
+def test_scope_flush_coalesces_into_one_patch():
+    # Label + annotation staged separately for the same node must flush
+    # as ONE combined metadata patch.
+    cluster = FakeCluster()
+    cluster.create_node(make_node("n0"))
+    plan = WritePlan(cluster)
+    scope = plan.begin_scope()
+    plan.stage("n0", labels={"roll/state": "cordon-required"})
+    plan.stage("n0", annotations={"roll/clock": "1", "roll/rung": "grace"})
+    names = plan.end_scope(scope)
+    flushed = plan.flush_nodes(names)
+    assert [i.name for i in flushed] == ["n0"]
+    assert cluster.stats["patch_node"] == 1
+    node = cluster.get_node("n0", cached=False)
+    assert node.metadata.labels["roll/state"] == "cordon-required"
+    assert node.metadata.annotations["roll/clock"] == "1"
+    assert node.metadata.annotations["roll/rung"] == "grace"
+    assert plan.counters()["coalesced_keys"] == 2  # 3 keys, 1 round trip
+
+
+# -- flow isolation --------------------------------------------------------
+
+
+def test_status_saturation_never_delays_mutating_writes():
+    clk = _Clock()
+    flows = FlowScheduler(
+        mutating_rate=100.0,
+        mutating_burst=10.0,
+        status_rate=1.0,
+        status_burst=2.0,
+        clock=clk,
+        sleep=clk.sleep,
+    )
+    # Saturate the status flow until it defers.
+    drained = 0
+    while flows.acquire(FLOW_STATUS):
+        drained += 1
+        assert drained < 100, "status bucket never dried"
+    assert flows.stats["deferred_status"] == 1
+    # Isolation by construction: mutating acquires must all succeed
+    # immediately — zero sleeps — while status is dry.
+    for _ in range(10):
+        assert flows.acquire(FLOW_MUTATING)
+    assert clk.sleeps == []
+    assert flows.stats.get("throttle_waits_mutating", 0) == 0
+
+
+def test_status_429_feedback_throttles_only_status_flow():
+    clk = _Clock()
+    flows = FlowScheduler(clock=clk, sleep=clk.sleep)
+    flows.feedback(FLOW_STATUS, retry_after_s=5.0)
+    state = flows.state()
+    assert state[FLOW_STATUS]["throttled"] == 1.0
+    assert state[FLOW_MUTATING]["throttled"] == 0.0
+    assert flows.acquire(FLOW_MUTATING)
+    assert clk.sleeps == []
+    # Status defers for the Retry-After window, then recovers.
+    assert not flows.acquire(FLOW_STATUS)
+    clk.now += 40.0
+    assert flows.acquire(FLOW_STATUS)
+
+
+def test_mutating_writes_bounded_wait_then_proceed():
+    # A mutating write out of tokens waits (bounded) and then goes
+    # through anyway — hygiene never drops a state transition.
+    clk = _Clock()
+    flows = FlowScheduler(
+        mutating_rate=0.001,
+        mutating_burst=1.0,
+        max_wait_s=0.5,
+        clock=clk,
+        sleep=clk.sleep,
+    )
+    assert flows.acquire(FLOW_MUTATING)  # burst token
+    assert flows.acquire(FLOW_MUTATING)  # dry bucket: waits, proceeds
+    assert flows.stats["overruns_mutating"] == 1
+    assert clk.sleeps and sum(clk.sleeps) <= 0.5 + 1e-9
+
+
+# -- stage-time suppression ------------------------------------------------
+
+
+def test_provider_suppresses_noop_state_write():
+    cluster = FakeCluster()
+    node = make_node(
+        "n0", labels={KEYS.state_label: UpgradeState.CORDON_REQUIRED.value}
+    )
+    cluster.create_node(node)
+    provider = NodeUpgradeStateProvider(
+        cluster,
+        KEYS,
+        event_recorder=EventRecorder(),
+        poll_interval_s=0.005,
+        poll_timeout_s=0.2,
+    )
+    provider.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+    assert cluster.stats.get("patch_node", 0) == 0
+    assert provider.plan.counters()["suppressed"] == 1
+
+
+# -- event aggregation -----------------------------------------------------
+
+
+def test_identical_event_storm_collapses():
+    cluster = FakeCluster()
+    plan = WritePlan(cluster)
+    event = {
+        "type": "Warning",
+        "reason": "DrainTimedOut",
+        "message": "drain timed out after 300s",
+        "involvedObject": {"kind": "Node", "name": "n0"},
+    }
+    for _ in range(30):
+        plan.stage_event("ns", dict(event))
+        plan.flush_events()
+    # First occurrence published immediately; the other 29 absorbed into
+    # the window.  The forced drain publishes ONE count-carrying update.
+    plan.flush_events(force=True)
+    published = cluster.list_events(namespace="ns")
+    assert cluster.stats["create_event"] == 2
+    assert max(e["count"] for e in published) == 30
+    c = plan.counters()
+    assert c["events_published"] == 2
+    assert c["events_aggregated"] == 28  # 29 absorbed - 1 carried live
+
+
+def test_distinct_events_do_not_aggregate():
+    cluster = FakeCluster()
+    plan = WritePlan(cluster)
+    for i in range(3):
+        plan.stage_event(
+            "ns",
+            {
+                "type": "Warning",
+                "reason": "DrainTimedOut",
+                "message": "drain timed out",
+                "involvedObject": {"kind": "Node", "name": f"n{i}"},
+            },
+        )
+    assert plan.flush_events() == 3
+    assert cluster.stats["create_event"] == 3

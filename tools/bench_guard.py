@@ -95,6 +95,25 @@ ELASTIC_N_SLICES = 4
 # when the gap stays under it.
 ELASTIC_GAP_CEILING_S = 0.5
 
+# Write-hygiene stage: the write-plane pins.  An active 256-node roll
+# coalesces every node transition (state label + its companion clock
+# annotations) into one metadata patch, so the budget per observed
+# state transition is the patch itself plus at most one scheduling
+# write (cordon/uncordon rides the same budget).  Anything above 2
+# means the plane stopped coalescing or a producer is writing around
+# it.
+WH_N_SLICES = 16
+WH_HOSTS_PER_SLICE = 16
+WH_WRITES_PER_TRANSITION_CEILING = 2.0
+# A 4096-node sharded fleet with no dirty pools must issue exactly 0
+# API writes per idle tick — suppression is a pin, not a target.
+WH_IDLE_TICKS = 50
+# Storm of identical events (same object/reason/message inside one
+# aggregation window) must collapse at least 10:1 into count-carrying
+# publishes.
+WH_EVENT_STORM = 50
+WH_EVENT_COLLAPSE_FLOOR = 10.0
+
 
 def measure(
     slices: int = N_SLICES,
@@ -666,6 +685,197 @@ def measure_heterogeneous(max_ticks: int = 400) -> dict:
     }
 
 
+def measure_write_hygiene(
+    slices: int = WH_N_SLICES,
+    hosts: int = WH_HOSTS_PER_SLICE,
+    idle_slices: int = SHARDED_N_SLICES,
+    idle_hosts: int = SHARDED_HOSTS_PER_SLICE,
+    idle_ticks: int = WH_IDLE_TICKS,
+    storm: int = WH_EVENT_STORM,
+) -> dict:
+    """Write-plane hygiene measurement; returns the artifact dict (also
+    embedded in BENCH_DETAILS.json by bench.py).
+
+    Three sub-pins: an active 256-node roll stays within the
+    writes-per-transition budget (coalescing works), a 4096-node
+    sharded idle tick issues exactly 0 writes (suppression works), and
+    an identical-event storm collapses >= 10:1 (aggregation works)."""
+    import time
+
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.k8s.informer import (
+        CachedKubeClient,
+        Informer,
+    )
+    from k8s_operator_libs_tpu.k8s.writeplan import WritePlan
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+    from k8s_operator_libs_tpu.upgrade.sharded import ShardedReconciler
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    def _node_writes(cluster) -> int:
+        # Every node patch variant on the fake (labels, annotations,
+        # combined metadata, cordon/uncordon) ticks the same verb.
+        return int(cluster.stats.get("patch_node", 0))
+
+    def _all_writes(cluster) -> int:
+        return int(
+            sum(
+                v
+                for k, v in cluster.stats.items()
+                if str(k)
+                .lower()
+                .startswith(
+                    ("patch", "create", "delete", "evict", "update", "post", "put")
+                )
+            )
+        )
+
+    # -- 1. active roll: writes per observed node state transition -----
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    nodes = []
+    for i in range(slices):
+        for n in fx.tpu_slice(f"pool-{i:02d}", hosts=hosts):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+            nodes.append(n.name)
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("25%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    informer = Informer(
+        cluster, pod_namespace=NAMESPACE, pod_match_labels=DRIVER_LABELS
+    )
+    cached = CachedKubeClient(cluster, informer=informer)
+    mgr = ClusterUpgradeStateManager(cached, keys=keys)
+    informer.sync()
+
+    def _states() -> dict:
+        return {
+            name: cluster.get_node(name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for name in nodes
+        }
+
+    transitions_total = 0
+    node_writes_total = 0
+    worst_ratio = 0.0
+    ticks_run = 0
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        before_states = _states()
+        before_writes = _node_writes(cluster)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        if not mgr.wait_for_async_work(30.0):
+            raise RuntimeError("async upgrade work did not drain")
+        after_states = _states()
+        tick_writes = _node_writes(cluster) - before_writes
+        tick_transitions = sum(
+            1
+            for name in nodes
+            if after_states[name] != before_states[name]
+        )
+        transitions_total += tick_transitions
+        node_writes_total += tick_writes
+        ticks_run += 1
+        if tick_transitions:
+            worst_ratio = max(worst_ratio, tick_writes / tick_transitions)
+        if all(
+            s == UpgradeState.DONE.value for s in after_states.values()
+        ):
+            break
+    else:
+        raise RuntimeError("active roll did not converge inside 120 s")
+    roll_ratio = node_writes_total / max(1, transitions_total)
+    plan = getattr(mgr, "write_plan", None)
+    counters = dict(plan.counters()) if plan is not None else {}
+
+    # -- 2. sharded idle fleet: exactly zero writes per tick -----------
+    idle_cluster = FakeCluster()
+    idle_fx = ClusterFixture(idle_cluster, keys)
+    idle_ds = idle_fx.daemon_set(hash_suffix="v1", revision=1)
+    for i in range(idle_slices):
+        for n in idle_fx.tpu_slice(
+            f"pool-{i:03d}", hosts=idle_hosts, state=UpgradeState.DONE
+        ):
+            idle_fx.driver_pod(n, idle_ds, hash_suffix="v1")
+    idle_informer = Informer(
+        idle_cluster, pod_namespace=NAMESPACE, pod_match_labels=DRIVER_LABELS
+    )
+    idle_cached = CachedKubeClient(idle_cluster, informer=idle_informer)
+    idle_mgr = ClusterUpgradeStateManager(idle_cached, keys=keys)
+    idle_informer.sync()
+    sharded = ShardedReconciler(idle_mgr, NAMESPACE, DRIVER_LABELS, shards=4)
+    try:
+        t0 = time.monotonic()
+        state = idle_mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        started = sharded.observe_full_state(state, policy, started=t0)
+        idle_mgr.apply_state(state, policy)
+        sharded.complete_full_resync(started)
+        writes_before = _all_writes(idle_cluster)
+        for _ in range(idle_ticks):
+            sharded.tick(policy)
+        idle_writes = _all_writes(idle_cluster) - writes_before
+        if not sharded.wait_idle(30.0):
+            raise RuntimeError("sharded reconcile did not drain")
+    finally:
+        sharded.shutdown()
+
+    # -- 3. identical-event storm collapses through the aggregator -----
+    storm_cluster = FakeCluster()
+    storm_plan = WritePlan(storm_cluster)
+    event = {
+        "type": "Warning",
+        "reason": "UpgradeFailed",
+        "message": "drain timed out",
+        "involvedObject": {"kind": "Node", "name": "pool-00-w0"},
+        "source": {"component": "tpu-upgrade-controller"},
+    }
+    for _ in range(storm):
+        storm_plan.stage_event(NAMESPACE, dict(event))
+        storm_plan.flush_events()
+    storm_plan.flush_events(force=True)
+    published = int(storm_cluster.stats.get("create_event", 0))
+    collapse_ratio = storm / max(1, published)
+
+    return {
+        "nodes": slices * hosts,
+        "roll_ticks": ticks_run,
+        "roll_transitions": transitions_total,
+        "roll_node_writes": node_writes_total,
+        "roll_writes_per_transition": round(roll_ratio, 3),
+        "roll_worst_tick_writes_per_transition": round(worst_ratio, 3),
+        "writes_suppressed": int(counters.get("suppressed", 0)),
+        "writes_coalesced_keys": int(counters.get("coalesced_keys", 0)),
+        "conflict_replays": int(counters.get("conflict_replays", 0)),
+        "idle_nodes": idle_slices * idle_hosts,
+        "idle_ticks": idle_ticks,
+        "idle_writes_total": idle_writes,
+        "event_storm": storm,
+        "events_published": published,
+        "event_collapse_ratio": round(collapse_ratio, 1),
+        "writes_per_transition_ceiling": WH_WRITES_PER_TRANSITION_CEILING,
+        "event_collapse_floor": WH_EVENT_COLLAPSE_FLOOR,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -844,6 +1054,39 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (heterogeneous): {f}", file=sys.stderr)
+        return 1
+
+    hygiene = measure_write_hygiene()
+    failures = []
+    if (
+        hygiene["roll_writes_per_transition"]
+        > WH_WRITES_PER_TRANSITION_CEILING
+    ):
+        failures.append(
+            f"active roll spent "
+            f"{hygiene['roll_writes_per_transition']} node writes per "
+            f"state transition (ceiling "
+            f"{WH_WRITES_PER_TRANSITION_CEILING}) — the write plane "
+            "stopped coalescing or a producer writes around it"
+        )
+    if hygiene["idle_writes_total"] != 0:
+        failures.append(
+            f"{hygiene['idle_ticks']} idle sharded ticks at "
+            f"{hygiene['idle_nodes']} nodes issued "
+            f"{hygiene['idle_writes_total']} API writes (must be "
+            "exactly 0 — no-op suppression regressed)"
+        )
+    if hygiene["event_collapse_ratio"] < WH_EVENT_COLLAPSE_FLOOR:
+        failures.append(
+            f"identical-event storm collapsed only "
+            f"{hygiene['event_collapse_ratio']}:1 (floor "
+            f"{WH_EVENT_COLLAPSE_FLOOR}:1 — aggregation window broken)"
+        )
+    hygiene["ok"] = not failures
+    print(json.dumps(hygiene, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (write hygiene): {f}", file=sys.stderr)
         return 1
     return 0
 
