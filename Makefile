@@ -13,7 +13,7 @@ BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
 .PHONY: all test test-fast chaos lint typecheck cov-report bench \
 	bench-guard graft-check clean generate generate-check docker-build \
-	docker-push .build-image
+	docker-push .build-image plan
 
 all: lint test
 
@@ -99,6 +99,13 @@ bench:
 # one delta walks exactly 1 pool (see tools/bench_guard.py).
 bench-guard:
 	$(PYTHON) tools/bench_guard.py
+
+# Print the analytic roll plan for the current cluster without issuing
+# a single API write verb (the controller's --dry-run path; see
+# docs/rollout-planning.md).  Pass ARGS="--namespace ... --policy ..."
+# to point it at a live CR.
+plan:
+	$(PYTHON) -m k8s_operator_libs_tpu.controller --dry-run $(ARGS)
 
 graft-check:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -1352,6 +1352,7 @@ def main() -> None:
     from bench_guard import (  # noqa: E402
         measure_elastic as measure_elastic_roll,
         measure_heterogeneous as measure_heterogeneous_roll,
+        measure_planner,
         measure_sharded as measure_sharded_reconcile,
         measure_write_hygiene,
     )
@@ -1404,6 +1405,14 @@ def main() -> None:
     write_hygiene = measure_write_hygiene()
     beat()
     log(f"write hygiene (coalesce/suppress/aggregate): {write_hygiene}")
+
+    # -- predictive planning (gated by `make bench-guard`) -------------------
+    # A 4096-node mixed-generation analytic plan under the wall ceiling
+    # with exactly 0 API write verbs, plus exact twin-vs-analytic wave
+    # agreement on a smaller mixed fleet.
+    planner = measure_planner()
+    beat()
+    log(f"planner (4096-node plan + twin agreement): {planner}")
 
     complete = seq_result["complete"]
     details = {
@@ -1460,6 +1469,7 @@ def main() -> None:
         },
         "heterogeneous": heterogeneous,
         "write_hygiene": write_hygiene,
+        "planner": planner,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),

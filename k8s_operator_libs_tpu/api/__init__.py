@@ -6,6 +6,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
     ElasticCoordinationSpec,
     EvictionEscalationSpec,
     IntOrString,
+    PlanningSpec,
     PodDeletionSpec,
     SliceHealthGateSpec,
     SliceQuarantineSpec,

@@ -75,6 +75,9 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("ElasticCoordinationSpec", "rejoin_timeout_second"): {"minimum": 0},
     ("PoolSpec", "name"): {"pattern": "^.+$"},
     ("PoolSpec", "max_parallel_upgrades"): {"minimum": 0},
+    ("PlanningSpec", "drift_threshold_second"): {"minimum": 0},
+    ("PlanningSpec", "replan_interval_second"): {"minimum": 0},
+    ("PlanningSpec", "max_replans"): {"minimum": 0},
 }
 
 

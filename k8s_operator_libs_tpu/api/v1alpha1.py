@@ -92,6 +92,8 @@ _JSON_NAME_OVERRIDES = {
     "pdb_grace_second": "pdbGraceSeconds",
     "offer_timeout_second": "offerTimeoutSeconds",
     "rejoin_timeout_second": "rejoinTimeoutSeconds",
+    "drift_threshold_second": "driftThresholdSeconds",
+    "replan_interval_second": "replanIntervalSeconds",
 }
 
 
@@ -474,6 +476,39 @@ class PoolSpec(_SpecBase):
 
 
 @dataclass
+class PlanningSpec(_SpecBase):
+    """Predictive rollout planning knobs (new component).
+
+    Tunes the drift watchdog that anchors an active roll to its
+    analytic plan: how far reality may diverge from the projection
+    before the controller re-plans, how often it may re-plan, and a
+    ceiling on automatic re-plans per roll.  Planning itself is always
+    on and read-only — these knobs only shape the watchdog's reaction.
+    """
+
+    # Drift (seconds behind projection) beyond which the watchdog
+    # re-plans from the live snapshot.
+    drift_threshold_second: int = 300
+    # Minimum seconds between automatic re-plans.
+    replan_interval_second: int = 60
+    # Ceiling on automatic re-plans per roll (planning must never
+    # become the hot path on a pathological fleet).
+    max_replans: int = 5
+
+    def validate(self) -> None:
+        if self.drift_threshold_second < 0:
+            raise ValidationError(
+                "planning.driftThresholdSeconds must be >= 0"
+            )
+        if self.replan_interval_second < 0:
+            raise ValidationError(
+                "planning.replanIntervalSeconds must be >= 0"
+            )
+        if self.max_replans < 0:
+            raise ValidationError("planning.maxReplans must be >= 0")
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -526,6 +561,9 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # its own driver target, budget overrides, and maintenance window.
     # Empty = the whole fleet is one implicit pool (prior behavior).
     pools: list[PoolSpec] = field(default_factory=list)
+    # Predictive rollout planning / drift-watchdog knobs; None = planner
+    # defaults (planning is always on — it is read-only).
+    planning: Optional[PlanningSpec] = None
 
     def validate(self) -> None:
         super().validate()
@@ -544,12 +582,58 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.slice_quarantine.validate()
         if self.elastic is not None:
             self.elastic.validate()
+        if self.planning is not None:
+            self.planning.validate()
         seen_pools: set[str] = set()
         for pool in self.pools:
             pool.validate()
             if pool.name in seen_pools:
                 raise ValidationError(f"duplicate pool name {pool.name!r}")
             seen_pools.add(pool.name)
+        self._validate_feasibility()
+
+    def _validate_feasibility(self) -> None:
+        """Admission-time plan feasibility: reject a policy whose roll
+        can PROVABLY never finish — a budget that admits zero units
+        regardless of fleet size, or a maintenance window whose cron is
+        syntactically valid but never matches a real instant (Feb 31).
+        Fleet-dependent deadlocks (a slice whose node cost exceeds a
+        nonzero cap) are a runtime planner/watchdog verdict — they
+        depend on the observed fleet, not the policy alone."""
+        from k8s_operator_libs_tpu.fleet.windows import next_open
+
+        huge = 1 << 30  # any positive percentage of this rounds up >= 1
+        if (
+            self.auto_upgrade
+            and self.max_unavailable is not None
+            and self.max_unavailable.scaled_value(huge, round_up=True) == 0
+        ):
+            raise ValidationError(
+                "maxUnavailable admits zero units: the roll can never "
+                "start (plan-infeasible)"
+            )
+        for pool in self.pools:
+            if (
+                pool.max_unavailable is not None
+                and pool.max_unavailable.scaled_value(huge, round_up=True)
+                == 0
+            ):
+                raise ValidationError(
+                    f"pool {pool.name!r}: maxUnavailable admits zero "
+                    "units — the pool can never be upgraded "
+                    "(plan-infeasible)"
+                )
+            window = pool.maintenance_window
+            if window is not None and window.cron:
+                try:
+                    opens = next_open(window.cron)
+                except ValueError:
+                    continue  # pool.validate() already rejected syntax
+                if opens is None:
+                    raise ValidationError(
+                        f"pool {pool.name!r}: maintenanceWindow.cron "
+                        f"{window.cron!r} never opens (plan-infeasible)"
+                    )
 
 
 # Nested-type registry for from_dict (maps (class, field) -> spec type).
@@ -565,6 +649,7 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("TPUUpgradePolicySpec", "health_gate"): SliceHealthGateSpec,
     ("TPUUpgradePolicySpec", "slice_quarantine"): SliceQuarantineSpec,
     ("TPUUpgradePolicySpec", "elastic"): ElasticCoordinationSpec,
+    ("TPUUpgradePolicySpec", "planning"): PlanningSpec,
     # List-of-nested: from_dict maps each element through the type.
     ("TPUUpgradePolicySpec", "pools"): PoolSpec,
     ("PoolSpec", "maintenance_window"): MaintenanceWindowSpec,

@@ -230,6 +230,17 @@ class UpgradeController:
         self.slice_timer = SliceUpgradeTimer(self.registry)
         # Stuck-state dwell gauge flows into the same registry.
         self.manager.stuck_detector.registry = self.registry
+        # Predictive rollout planning: the drift watchdog anchors the
+        # active roll to its analytic RollPlan after every full pass and
+        # republishes the ETA (CR status + metrics).  Planning is
+        # read-only — it never issues a write verb.
+        from k8s_operator_libs_tpu.planning.drift import DriftWatchdog
+
+        self.watchdog = DriftWatchdog(self.keys)
+        if self._sharded is not None:
+            # Scoped dirty ticks between full resyncs feed the watchdog
+            # as progress evidence (read-only observer).
+            self._sharded.progress_observer = self.watchdog.note_tick
         self.elector = None
         if config.leader_elect:
             from k8s_operator_libs_tpu.k8s.leader import (
@@ -359,6 +370,19 @@ class UpgradeController:
         except CircuitOpenError as e:
             self._handle_circuit_open(e)
             return False
+        # Drift watchdog: full passes only (a scoped pass sees one pool
+        # and cannot measure fleet progress).  Read-only — plan_roll and
+        # find_infeasibilities never touch the API.
+        if self.config.policy is not None:
+            self.watchdog.configure(
+                getattr(self.config.policy, "planning", None)
+            )
+            drift_report = self.watchdog.observe(
+                self.manager, state, self.config.policy
+            )
+        else:
+            drift_report = None
+        self.metrics.observe_plan(drift_report)
         if self.config.policy_ref is not None:
             self._update_cr_status(state)
         duration = time.monotonic() - t0
@@ -391,6 +415,64 @@ class UpgradeController:
         self.metrics.observe_sharded(self._sharded, report)
         self._flush_events()
         return report.errors == 0 and report.fenced == 0
+
+    def dry_run(self):
+        """Build one read-only snapshot, return the analytic RollPlan,
+        and PROVE the pass wrote nothing: every write verb the client
+        observed and everything the transactional write plane issued
+        must be zero (the ISSUE's planning-is-read-only contract)."""
+        from k8s_operator_libs_tpu.planning.planner import plan_roll
+
+        if self.config.policy_ref is not None:
+            self._refresh_policy_from_cr()
+        before = self._write_verb_count()
+        state = self.manager.build_state(
+            self.config.namespace,
+            self.config.driver_labels,
+            self.config.policy,
+        )
+        plan = plan_roll(self.manager, state, self.config.policy)
+        writes = self._write_verb_count() - before
+        if writes:
+            raise RuntimeError(
+                f"dry-run issued {writes} API write verb(s); planning "
+                "must be read-only"
+            )
+        return plan
+
+    def _write_verb_count(self) -> float:
+        """Write verbs observed so far: client per-verb stats (fake and
+        REST clients both expose ``stats``) plus everything the write
+        plane has flushed."""
+        total = 0.0
+        stats = getattr(
+            getattr(self.manager, "client", None), "stats", None
+        )
+        if stats is not None and hasattr(stats, "items"):
+            total += sum(
+                v
+                for k, v in stats.items()
+                if str(k)
+                .lower()
+                .startswith(
+                    (
+                        "patch",
+                        "create",
+                        "delete",
+                        "evict",
+                        "update",
+                        "post",
+                        "put",
+                    )
+                )
+            )
+        plan = self.write_plan
+        if plan is not None and hasattr(plan, "counters"):
+            c = plan.counters()
+            total += c.get("writes_mutating", 0) + c.get(
+                "writes_status", 0
+            )
+        return total
 
     def _open_circuit_count(self) -> int:
         breaker = getattr(self.client, "breaker", None)
@@ -625,6 +707,20 @@ class UpgradeController:
                 ),
                 "quarantineCycleDemotions": m.quarantine_cycle_demotions,
             }
+            # Predictive-planning surface (drift watchdog; durable so the
+            # status CLI can render the plan section from the CR alone).
+            report = self.watchdog.last_report
+            if report is not None and report.active:
+                status["projectedCompletion"] = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ",
+                    time.gmtime(report.projected_completion_epoch),
+                )
+                status["planDriftSeconds"] = int(report.drift_seconds)
+                status["planWaves"] = report.wave_count
+                status["planCompletedGroups"] = report.completed_groups
+                status["planReplans"] = report.replans
+                if report.infeasible:
+                    status["planInfeasible"] = list(report.infeasible)
             status["conditions"] = self._conditions(
                 status, (cr.get("status") or {}).get("conditions") or []
             )
@@ -1165,6 +1261,13 @@ def main(argv: Optional[list[str]] = None) -> None:
         "at most one shard at a time)",
     )
     parser.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="build one read-only snapshot, print the analytic RollPlan "
+        "(waves, per-wave durations, projected completion, holds, "
+        "infeasibility) and exit without issuing a single API write verb",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="run leader election over a coordination.k8s.io Lease and "
@@ -1234,6 +1337,9 @@ def main(argv: Optional[list[str]] = None) -> None:
             lease_namespace=args.lease_namespace or None,
         ),
     )
+    if args.dry_run:
+        print(controller.dry_run().render())
+        return
     signal.signal(signal.SIGTERM, controller.stop)
     signal.signal(signal.SIGINT, controller.stop)
     controller.run_forever()

@@ -444,6 +444,51 @@ class UpgradeMetrics:
             "Groups currently holding in the budget-free window-wait "
             "condition",
         )
+        r.describe(
+            "fleet_window_invalid",
+            "1 while the pool's maintenanceWindow cron fails to parse at "
+            "runtime (the engine fails OPEN; see WindowCronInvalid events)",
+            "pool",
+        )
+        # Predictive rollout-planning surface (planning/; absent until a
+        # roll is active and the drift watchdog has anchored a plan).
+        r.describe(
+            "fleet_roll_infeasible",
+            "1 per structural reason the active roll can provably never "
+            "finish (window-starvation, budget-deadlock, "
+            "elastic-decline-storm)",
+            "reason",
+        )
+        r.describe(
+            "plan_waves",
+            "Upgrade waves in the anchored roll plan",
+        )
+        r.describe(
+            "plan_groups",
+            "Groups covered by the anchored roll plan",
+        )
+        r.describe(
+            "plan_completed_groups",
+            "Planned groups that have reached upgrade-done",
+        )
+        r.describe(
+            "plan_projected_completion_timestamp_seconds",
+            "Projected roll completion (unix epoch), drift-adjusted",
+        )
+        r.describe(
+            "plan_drift_seconds",
+            "Lateness of the next planned completion (positive = behind "
+            "plan, negative = ahead)",
+        )
+        r.describe(
+            "plan_infeasible",
+            "Count of structural plan-infeasibility reasons currently "
+            "detected (0 = the roll can finish)",
+        )
+        r.describe(
+            "plan_replans_total",
+            "Bounded re-plans triggered by drift over threshold",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -638,6 +683,11 @@ class UpgradeMetrics:
             "fleet_window_held_groups",
             getattr(manager, "window_held_groups", 0),
         )
+        cron_invalid = getattr(manager, "window_cron_invalid", None)
+        if cron_invalid is not None:
+            r.clear("fleet_window_invalid")
+            for pool in sorted(cron_invalid):
+                r.set("fleet_window_invalid", 1, pool=pool)
         # Fused-battery surface: import lazily so a controller built
         # without jax (pure NodeReportProber aggregation) still exports
         # everything else.
@@ -698,6 +748,35 @@ class UpgradeMetrics:
                 "informer_snapshot_age_seconds",
                 age if age != float("inf") else -1.0,
             )
+
+    def observe_plan(self, report) -> None:
+        """Publish the drift watchdog's verdict (a planning.DriftReport).
+
+        An inactive report clears the whole surface so a finished roll's
+        ETA does not linger as a stale promise.
+        """
+        r = self.registry
+        if report is None or not report.active:
+            for name in (
+                "plan_waves",
+                "plan_groups",
+                "plan_completed_groups",
+                "plan_projected_completion_timestamp_seconds",
+                "plan_drift_seconds",
+                "plan_infeasible",
+            ):
+                r.clear(name)
+            return
+        r.set("plan_waves", report.wave_count)
+        r.set("plan_groups", report.planned_groups)
+        r.set("plan_completed_groups", report.completed_groups)
+        r.set(
+            "plan_projected_completion_timestamp_seconds",
+            report.projected_completion_epoch,
+        )
+        r.set("plan_drift_seconds", report.drift_seconds)
+        r.set("plan_infeasible", len(report.infeasible))
+        r.set("plan_replans_total", report.replans)
 
     def observe_sharded(self, sharded, report=None) -> None:
         """Publish the sharded-reconcile surface.  Called with a

@@ -88,6 +88,19 @@ WRITEPLANE_METRIC_KEYS = {
 }
 
 
+# Controller /metrics series → status keys for the plan section
+# (unlabeled series only; fleet_roll_infeasible{reason=...} and
+# fleet_window_invalid{pool=...} are parsed label-aware below).
+PLAN_METRIC_KEYS = {
+    "plan_waves": "waves",
+    "plan_groups": "plannedGroups",
+    "plan_completed_groups": "completedGroups",
+    "plan_projected_completion_timestamp_seconds": "projectedCompletionEpoch",
+    "plan_drift_seconds": "driftSeconds",
+    "plan_replans_total": "replans",
+}
+
+
 def _metrics_text(metrics_url: str, fetch=None) -> str:
     """Fetch the exposition text; ``fetch`` is injectable for tests."""
     if fetch is None:
@@ -284,6 +297,56 @@ def write_plane_health(metrics_url: str, fetch=None) -> Optional[dict]:
     return out if plane_only else None
 
 
+def plan_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Predictive-planning health from the controller's /metrics: the
+    anchored plan's projected waves, drift-adjusted ETA, and any
+    structural infeasibility reasons the drift watchdog detected.
+
+    Returns None when the plan family is absent (no active roll — the
+    watchdog clears its gauges when the roll finishes), an
+    ``{"error": ...}`` dict when the endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    infeasible: list[str] = []
+    invalid_windows: list[str] = []
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "fleet_roll_infeasible":
+            reason = labels.split('reason="', 1)
+            if len(reason) == 2 and val:
+                infeasible.append(reason[1].split('"', 1)[0])
+        elif short == "fleet_window_invalid":
+            pool = labels.split('pool="', 1)
+            if len(pool) == 2 and val:
+                invalid_windows.append(pool[1].split('"', 1)[0])
+        else:
+            key = PLAN_METRIC_KEYS.get(short)
+            if key is not None:
+                out[key] = val
+    if infeasible:
+        out["infeasible"] = sorted(infeasible)
+    if invalid_windows:
+        out["invalidWindows"] = sorted(invalid_windows)
+    # plan_replans_total alone is published even with no active roll —
+    # require a wave/ETA series before reporting a section.
+    return out if set(out) - {"replans"} else None
+
+
 def gather(
     client: KubeClient,
     namespace: str,
@@ -331,6 +394,22 @@ def gather(
                 or {},
                 "rollbackAttempts": cr_status.get("rollbackAttempts") or {},
             }
+            # Durable planning surface (written by the drift watchdog
+            # each full pass; survives a controller restart).
+            cr_plan = {
+                key: cr_status[key]
+                for key in (
+                    "projectedCompletion",
+                    "planDriftSeconds",
+                    "planWaves",
+                    "planCompletedGroups",
+                    "planReplans",
+                    "planInfeasible",
+                )
+                if key in cr_status
+            }
+            if cr_plan:
+                policy_section["plan"] = cr_plan
             try:
                 policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
             except (ValueError, TypeError):
@@ -509,6 +588,9 @@ def gather(
         plane = write_plane_health(metrics_url, fetch=metrics_fetch)
         if plane is not None:
             out["writePlane"] = plane
+        plan = plan_health(metrics_url, fetch=metrics_fetch)
+        if plan is not None:
+            out["plan"] = plan
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -743,6 +825,52 @@ def render(status: dict) -> str:
                 f"replay(s), {int(plane.get('deferred', 0))} deferred, "
                 f"{int(plane.get('throttleWaits', 0))} throttle wait(s)"
             )
+    plan = status.get("plan")
+    # The durable CR-status copy backs the section when the live metrics
+    # endpoint was not consulted (or had no active roll).
+    if plan is None:
+        cr_plan = (status.get("policy") or {}).get("plan")
+        if cr_plan:
+            plan = {
+                "waves": cr_plan.get("planWaves", 0),
+                "completedGroups": cr_plan.get("planCompletedGroups", 0),
+                "driftSeconds": cr_plan.get("planDriftSeconds", 0),
+                "replans": cr_plan.get("planReplans", 0),
+                "projectedCompletion": cr_plan.get(
+                    "projectedCompletion", ""
+                ),
+                "infeasible": cr_plan.get("planInfeasible") or [],
+            }
+    if plan is not None:
+        lines.append("")
+        if "error" in plan:
+            lines.append(f"plan: {plan['error']}")
+        else:
+            eta = plan.get("projectedCompletion", "")
+            if not eta and plan.get("projectedCompletionEpoch"):
+                import time as _time
+
+                eta = _time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ",
+                    _time.gmtime(plan["projectedCompletionEpoch"]),
+                )
+            drift = float(plan.get("driftSeconds", 0))
+            lines.append(
+                f"plan: {int(plan.get('completedGroups', 0))}/"
+                f"{int(plan.get('plannedGroups', plan.get('waves', 0)))} "
+                f"group(s) done over {int(plan.get('waves', 0))} wave(s)"
+                f" | drift {drift:+.0f}s"
+                f" | replans {int(plan.get('replans', 0))}"
+                + (f" | ETA {eta}" if eta else "")
+            )
+            for reason in plan.get("infeasible") or []:
+                lines.append(f"  INFEASIBLE: {reason}")
+            invalid = plan.get("invalidWindows") or []
+            if invalid:
+                lines.append(
+                    "  invalid maintenance-window cron (failing open): "
+                    + ", ".join(invalid)
+                )
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")
@@ -778,7 +906,7 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--metrics-url",
         default="",
         help="controller /metrics endpoint (e.g. http://HOST:9090/metrics);"
-        " adds the sharded-reconcile and write-plane health sections",
+        " adds the sharded-reconcile, write-plane and plan health sections",
     )
     parser.add_argument("--json", action="store_true", dest="as_json")
     args = parser.parse_args(argv)

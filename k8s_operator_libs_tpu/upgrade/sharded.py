@@ -666,6 +666,11 @@ class ShardedReconciler:
         self._pool_accel: dict[str, str] = {}
         # group id → policy pool name, for the ledger's per-pool caps.
         self._group_pool: dict[str, str] = {}
+        # Plan-drift progress hook (planning/drift.py): called with each
+        # TickReport that walked pools, so the drift watchdog observes
+        # scoped-pass activity between full resyncs without polling the
+        # queue.  Read-only consumer; exceptions must not kill the tick.
+        self.progress_observer: Optional[Callable[[TickReport], None]] = None
 
     # -- feed ----------------------------------------------------------------
 
@@ -815,6 +820,11 @@ class ShardedReconciler:
                 logger.warning("leaked write-plan intent flush failed: %s", e)
         report.queue_depth_after = self.queue.depth()
         report.duration_s = time.monotonic() - t0
+        if self.progress_observer is not None and report.pools_walked:
+            try:
+                self.progress_observer(report)
+            except Exception as e:  # noqa: BLE001 — observer is telemetry
+                logger.warning("plan progress observer failed: %s", e)
         return report
 
     def _reconcile_pool(self, key: str, policy) -> str:

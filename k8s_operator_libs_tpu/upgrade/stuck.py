@@ -44,6 +44,13 @@ DEFAULT_STUCK_THRESHOLD_S = 300.0
 DEFAULT_RE_EMIT_INTERVAL_S = 60.0
 
 
+def _reason_slug(reason: str) -> str:
+    """Stable low-cardinality metric label from a reason string: the
+    ``kind:`` prefix (``window-starvation``, ``budget-deadlock``,
+    ``elastic-decline-storm``)."""
+    return reason.split(":", 1)[0].strip() or "unknown"
+
+
 @dataclass
 class StuckGroup:
     """One currently-stuck group, as reported by observe()."""
@@ -90,6 +97,12 @@ class StuckStateDetector:
         self._failed_reason_sources: list[
             Callable[[str], Optional[str]]
         ] = []
+        # Fleet-level "will this roll ever finish" signal (see
+        # observe_fleet): the planner's structural infeasibility reasons
+        # from the last full pass, for metrics/status/the controller.
+        self.fleet_infeasibility: list[str] = []
+        self._fleet_last_emit: dict[str, float] = {}
+        self._fleet_published: set[str] = set()
 
     def add_reason_source(
         self, source: Callable[[str], Optional[str]]
@@ -163,6 +176,87 @@ class StuckStateDetector:
             self._last_emit.pop(gone, None)
             self._drop_series(gone)
         return stuck
+
+    def observe_fleet(
+        self, state, policy, manager=None, now: Optional[float] = None
+    ) -> list[str]:
+        """Fleet-level stuck signal: will this roll EVER finish?
+
+        Per-group dwell (observe) catches a slice wedged in one state;
+        it is silent about a roll that makes no progress for structural
+        reasons — a maintenance window that never opens, a budget that
+        can never admit the smallest pending group, an elastic-decline
+        storm burning offer timeouts.  This pass asks the planner's
+        cheap feasibility scan those questions every full resync and
+        reports the answers as plan infeasibility: a
+        ``fleet_roll_infeasible{reason}`` gauge per reason plus a
+        throttled RollInfeasible Warning on one representative node per
+        pending group's fleet.  Read-only, like everything here."""
+        if manager is None:
+            self.fleet_infeasibility = []
+            return []
+        now_mono = time.monotonic() if now is None else now
+        # Lazy import: planning imports the fleet helpers; importing it
+        # at module top would cycle through the upgrade package.
+        from k8s_operator_libs_tpu.planning.planner import (
+            find_infeasibilities,
+        )
+
+        reasons = find_infeasibilities(manager, state, policy)
+        self.fleet_infeasibility = reasons
+        slugs = {_reason_slug(r): r for r in reasons}
+        if self.registry is not None:
+            for slug in set(self._fleet_published) - set(slugs):
+                self.registry.remove("fleet_roll_infeasible", reason=slug)
+                self._fleet_published.discard(slug)
+            for slug in slugs:
+                self.registry.set("fleet_roll_infeasible", 1, reason=slug)
+                self._fleet_published.add(slug)
+        if not reasons:
+            self._fleet_last_emit.clear()
+            return reasons
+        anchor = None
+        for group in state.groups_in(UpgradeState.UPGRADE_REQUIRED):
+            if group.nodes:
+                anchor = group.nodes[0].name
+                break
+        if anchor is None:
+            # Window-starved rolls have no visible pending group (the
+            # hold drops them from the snapshot): anchor on a held
+            # group's recorded node so the Warning still lands somewhere
+            # describable.
+            held_info = getattr(manager, "window_held_info", None) or {}
+            for entries in held_info.values():
+                for entry in entries:
+                    if len(entry) >= 3 and entry[2]:
+                        anchor = entry[2]
+                        break
+                if anchor is not None:
+                    break
+        if anchor is None:
+            for group in state.all_groups():
+                if group.nodes:
+                    anchor = group.nodes[0].name
+                    break
+        for slug, reason in slugs.items():
+            last = self._fleet_last_emit.get(slug)
+            if (
+                last is not None
+                and now_mono - last < self.re_emit_interval_s
+            ):
+                continue
+            self._fleet_last_emit[slug] = now_mono
+            message = f"Roll is plan-infeasible: {reason}"
+            logger.warning("%s", message)
+            if anchor is not None:
+                log_event(
+                    self.event_recorder,
+                    anchor,
+                    EVENT_TYPE_WARNING,
+                    "RollInfeasible",
+                    message,
+                )
+        return reasons
 
     def _drop_series(self, group_id: str) -> None:
         state_label = self._published.pop(group_id, None)

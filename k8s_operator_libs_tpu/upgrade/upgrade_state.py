@@ -295,6 +295,20 @@ class ClusterUpgradeStateManager:
         self.preemptions: dict[str, int] = {}
         self.pool_window_open: dict[str, bool] = {}
         self.window_held_groups = 0
+        # Window-held groups per pool, (group id, size, anchor node) —
+        # the hold drops
+        # them from the pass's snapshot, so the planner's feasibility
+        # scan (find_infeasibilities) reads them from here instead: a
+        # pool whose window never opens again must still be reported as
+        # window-starved even though no pending group remains visible.
+        self.window_held_info: dict[str, list[tuple[str, int, str]]] = {}
+        # Runtime window-validation gap: pool name -> the unparseable
+        # cron it is currently failing OPEN on (admission validates
+        # crons, but a mid-run CR edit bypasses it).  Metrics publishes
+        # fleet_window_invalid{pool} from this; the emitted set throttles
+        # the WindowCronInvalid Warning to once per fail-open episode.
+        self.window_cron_invalid: dict[str, str] = {}
+        self._window_invalid_emitted: set[str] = set()
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -856,6 +870,13 @@ class ClusterUpgradeStateManager:
             # passes see one pool, so stuck detection runs at the full
             # -resync cadence instead.
             self.stuck_detector.observe(current_state)
+            # Fleet-level "will this roll ever finish": window
+            # starvation / budget deadlock / elastic-decline storms are
+            # reported as plan infeasibility within one resync interval,
+            # not discovered by waiting out a per-group dwell.
+            self.stuck_detector.observe_fleet(
+                current_state, policy, manager=self
+            )
         logger.info("state manager finished processing")
 
     # -- processors ----------------------------------------------------------
@@ -1850,16 +1871,42 @@ class ClusterUpgradeStateManager:
             if window is not None and window.cron:
                 try:
                     is_open = window_open(window.cron)
+                    self.window_cron_invalid.pop(pool.name, None)
+                    self._window_invalid_emitted.discard(pool.name)
                 except ValueError:
                     # Schema validation rejects bad crons; an unparseable
                     # leftover must fail OPEN — a typo in a window must
-                    # not freeze the pool forever.
+                    # not freeze the pool forever.  But never silently:
+                    # record the fail-open so metrics can raise
+                    # fleet_window_invalid{pool} and the group loop below
+                    # emits a WindowCronInvalid Warning once.
                     is_open = True
+                    self.window_cron_invalid[pool.name] = window.cron
+            elif window is None or not window.cron:
+                self.window_cron_invalid.pop(pool.name, None)
+                self._window_invalid_emitted.discard(pool.name)
             open_by_pool[pool.name] = is_open
         self.pool_window_open = open_by_pool
         held = 0
+        self.window_held_info = {}
         for group in list(state.all_groups()):
             pool_name = self._pool_for_group(group, policy)
+            if (
+                pool_name in self.window_cron_invalid
+                and pool_name not in self._window_invalid_emitted
+                and group.members
+            ):
+                self._window_invalid_emitted.add(pool_name)
+                log_event(
+                    self.event_recorder,
+                    group.members[0].node.name,
+                    EVENT_TYPE_WARNING,
+                    "WindowCronInvalid",
+                    f"Pool {pool_name} maintenanceWindow cron "
+                    f"{self.window_cron_invalid[pool_name]!r} is "
+                    "unparseable; failing OPEN (window treated as "
+                    "always open) until the CR is fixed",
+                )
             carriers = [
                 m.node
                 for m in group.members
@@ -1893,6 +1940,12 @@ class ClusterUpgradeStateManager:
             if self.budget_ledger is not None:
                 self.budget_ledger.release(group.id)
             held += 1
+            anchor_node = (
+                group.members[0].node.name if group.members else ""
+            )
+            self.window_held_info.setdefault(pool_name, []).append(
+                (group.id, group.size(), anchor_node)
+            )
             self._remove_group_from_snapshot(state, group)
         self.window_held_groups = held
 

@@ -1,0 +1,1012 @@
+"""Predictive rollout planning (planning/): the deterministic analytic
+planner, the digital-twin cross-check against the real engine, the
+structural-infeasibility batteries (budget deadlock, window starvation,
+elastic-decline storms), the admission feasibility gate, the runtime
+window-validation gap, the drift watchdog, and the dry-run zero-write
+contract.
+
+The headline test is the seeded fuzz cross-check: on random
+mixed-generation fleets the analytic planner's wave count and node→wave
+assignment must agree exactly with what the real engine does to a
+cloned fleet on an accelerated clock.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    PlanningSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.api.v1alpha1 import (
+    MaintenanceWindowSpec,
+    PoolSpec,
+    ValidationError,
+)
+from k8s_operator_libs_tpu.controller import ControllerConfig, UpgradeController
+from k8s_operator_libs_tpu.fleet.windows import next_open
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.metrics import MetricsRegistry, UpgradeMetrics
+from k8s_operator_libs_tpu.planning import (
+    PlanAssumptions,
+    plan_roll,
+    find_infeasibilities,
+    run_twin,
+    DriftWatchdog,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import (
+    GKE_TPU_ACCELERATOR_LABEL,
+)
+from k8s_operator_libs_tpu.upgrade.util import EventRecorder
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+V4 = "tpu-v4-podslice"
+V5E = "tpu-v5-lite-podslice"
+V6E = "tpu-v6e-slice"
+
+# A cron that can never fire: February 31st does not exist.
+NEVER_CRON = "0 0 31 2 *"
+ALWAYS_CRON = "* * * * *"
+
+
+def _manager(cluster, **kwargs):
+    kwargs.setdefault("event_recorder", EventRecorder())
+    return ClusterUpgradeStateManager(
+        cluster, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0,
+        **kwargs,
+    )
+
+
+def _outdated_fleet(
+    cluster,
+    slices=4,
+    hosts=2,
+    accelerators=None,
+    dcn_of=None,
+):
+    """`slices` complete TPU slices, all DONE at driver v1, then the
+    DaemonSet template bumps to v2 — every slice is outdated."""
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    for i in range(slices):
+        accel = (
+            accelerators[i % len(accelerators)]
+            if accelerators
+            else "tpu-v5p-slice"
+        )
+        nodes = fx.tpu_slice(
+            f"pool-{i}",
+            hosts=hosts,
+            state=UpgradeState.DONE,
+            accelerator=accel,
+            **({"dcn_group": dcn_of(i)} if dcn_of else {}),
+        )
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    return fx, ds
+
+
+def _policy(**kwargs):
+    kwargs.setdefault("auto_upgrade", True)
+    kwargs.setdefault("drain_spec", DrainSpec(enable=False))
+    return TPUUpgradePolicySpec(**kwargs)
+
+
+def _snapshot(cluster, policy):
+    mgr = _manager(cluster)
+    return mgr, mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+
+
+# -- fleet/windows.next_open --------------------------------------------------
+
+
+class TestNextOpen:
+    def test_open_now_returns_now(self):
+        now = 1_700_000_000.0
+        assert next_open(ALWAYS_CRON, now) == now
+
+    def test_future_opening_is_found(self):
+        # 2023-11-14T22:13:20Z; window opens daily 00:00-00:59 UTC.
+        now = 1_700_000_000.0
+        opens = next_open("* 0 * * *", now)
+        assert opens is not None and opens > now
+        import time as _t
+
+        tm = _t.gmtime(opens)
+        assert (tm.tm_hour, tm.tm_min) == (0, 0)
+
+    def test_never_opening_cron_returns_none(self):
+        assert next_open(NEVER_CRON, 1_700_000_000.0) is None
+
+    def test_malformed_cron_raises(self):
+        with pytest.raises(ValueError):
+            next_open("not a cron", 1_700_000_000.0)
+
+    def test_minute_resolution_not_skipped(self):
+        # Opens exactly at minute 30 of hour 5; asking one second before
+        # must find it, not skip to the next day.
+        now = 1_700_000_000.0
+        opens = next_open("30 5 * * *", now)
+        import time as _t
+
+        tm = _t.gmtime(opens)
+        assert (tm.tm_hour, tm.tm_min) == (5, 30)
+        assert opens - now < 2 * 86400
+
+
+# -- analytic planner ---------------------------------------------------------
+
+
+class TestPlanner:
+    def test_waves_respect_fleet_budget(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=6, hosts=2)
+        policy = _policy(
+            max_parallel_upgrades=2, max_unavailable=IntOrString(2)
+        )
+        policy.validate()
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(mgr, state, policy, now=1_700_000_000.0)
+        assert plan.wave_count == 3
+        assert plan.pending_groups == 6
+        assert not plan.infeasible
+        assert all(len(w.group_ids) == 2 for w in plan.waves)
+        # Waves are sequential: offsets accumulate durations.
+        assert plan.waves[1].start_offset_s == pytest.approx(
+            plan.waves[0].duration_s
+        )
+        assert plan.projected_completion_epoch == pytest.approx(
+            1_700_000_000.0 + plan.projected_duration_s
+        )
+        # Every node is assigned a wave.
+        assert len(plan.node_wave) == 12
+
+    def test_planning_is_read_only(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=4)
+        policy = _policy(max_unavailable=IntOrString(1))
+        mgr, state = _snapshot(cluster, policy)
+        write_prefixes = (
+            "patch", "create", "delete", "evict", "update", "post", "put",
+        )
+
+        def writes():
+            return sum(
+                c
+                for verb, c in cluster.stats.items()
+                if verb.lower().startswith(write_prefixes)
+            )
+
+        before = writes()
+        plan_roll(mgr, state, policy)
+        find_infeasibilities(mgr, state, policy)
+        assert writes() == before
+
+    def test_oldest_generation_first_ordering(self):
+        cluster = FakeCluster()
+        _outdated_fleet(
+            cluster, slices=3, hosts=2, accelerators=[V6E, V5E, V4]
+        )
+        policy = _policy(max_unavailable=IntOrString(1))
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(mgr, state, policy)
+        assert plan.wave_count == 3
+        # pool-2 is v4 (oldest), pool-1 v5e, pool-0 v6e.
+        assert plan.waves[0].group_ids == ["pool-2"]
+        assert plan.waves[1].group_ids == ["pool-1"]
+        assert plan.waves[2].group_ids == ["pool-0"]
+
+    def test_dcn_anti_affinity_splits_waves(self):
+        cluster = FakeCluster()
+        _outdated_fleet(
+            cluster, slices=4, hosts=2, dcn_of=lambda i: f"mesh-{i % 2}"
+        )
+        policy = _policy(
+            max_unavailable=IntOrString(4),
+            max_parallel_upgrades=0,  # unlimited; DCN is the only gate
+            dcn_anti_affinity=True,
+        )
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(mgr, state, policy)
+        # Budget admits all four at once, but two share mesh-0 and two
+        # share mesh-1: at most one slice per DCN group per wave.
+        assert plan.wave_count == 2
+        for wave in plan.waves:
+            assert len(wave.group_ids) == 2
+
+    def test_skip_label_and_preemption_hold(self):
+        cluster = FakeCluster()
+        fx, _ds = _outdated_fleet(cluster, slices=3, hosts=1)
+        node = cluster.list_nodes()[0]
+        cluster.patch_node_labels(
+            node.name, {KEYS.skip_label: "true"}
+        )
+        policy = _policy(max_unavailable=IntOrString(3))
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(
+            mgr,
+            state,
+            policy,
+            assumptions=PlanAssumptions(
+                preempted_groups=frozenset({"pool-1"})
+            ),
+        )
+        skipped_pool = node.labels["cloud.google.com/gke-nodepool"]
+        assert "skip" in plan.held[skipped_pool]
+        assert "preempted" in plan.held["pool-1"]
+        planned_ids = {g.group_id for g in plan.groups}
+        assert skipped_pool not in planned_ids
+        assert "pool-1" not in planned_ids
+
+    def test_closed_window_delays_start(self):
+        now = 1_700_000_000.0  # 22:13 UTC — outside hour-0 window
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=2, hosts=2, accelerators=[V4])
+        policy = _policy(
+            max_unavailable=IntOrString(2),
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron="* 0 * * *"
+                    ),
+                )
+            ],
+        )
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(mgr, state, policy, now=now)
+        assert plan.wave_count >= 1
+        opens = next_open("* 0 * * *", now)
+        assert plan.waves[0].start_offset_s == pytest.approx(opens - now)
+        assert not plan.infeasible
+
+    def test_never_opening_window_is_starvation(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=2, hosts=2, accelerators=[V4])
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ],
+        )
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(mgr, state, policy)
+        assert plan.wave_count == 0
+        assert any(
+            r.startswith("window-starvation") for r in plan.infeasible
+        )
+        assert set(plan.held.values()) == {"window-starved"}
+
+    def test_budget_deadlock_in_node_units(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set(hash_suffix="v2", revision=2)
+        for n in fx.tpu_slice(
+            "big", hosts=4, state=UpgradeState.UPGRADE_REQUIRED
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v2")
+        # Node-unit budget of 1 can never admit a 4-host atomic slice.
+        policy = _policy(
+            max_unavailable=IntOrString(1), unavailability_unit="node"
+        )
+        mgr, state = _snapshot(cluster, policy)
+        assert mgr._unavailability_unit(policy) == "node"
+        plan = plan_roll(mgr, state, policy)
+        assert plan.wave_count == 0
+        assert any(
+            r.startswith("budget-deadlock") for r in plan.infeasible
+        )
+
+    def test_elastic_answer_changes_duration(self):
+        cluster = FakeCluster()
+        fx, _ds = _outdated_fleet(cluster, slices=1, hosts=2)
+        for n in cluster.list_nodes():
+            cluster.patch_node_annotations(
+                n.name, {KEYS.elastic_workload_annotation: "jobset-a"}
+            )
+        from k8s_operator_libs_tpu.api.v1alpha1 import (
+            ElasticCoordinationSpec,
+        )
+
+        policy = _policy(
+            elastic=ElasticCoordinationSpec(
+                enable=True, offer_timeout_second=600
+            )
+        )
+        mgr, state = _snapshot(cluster, policy)
+        fast = plan_roll(
+            mgr, state, policy,
+            assumptions=PlanAssumptions(elastic_answer="accept"),
+        )
+        slow = plan_roll(
+            mgr, state, policy,
+            assumptions=PlanAssumptions(elastic_answer="timeout"),
+        )
+        assert (
+            slow.projected_duration_s
+            >= fast.projected_duration_s + 590
+        )
+
+
+# -- structural infeasibility (cheap scan) ------------------------------------
+
+
+class TestFindInfeasibilities:
+    def _pending_pool_fleet(self, cluster, hosts=2):
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set(hash_suffix="v2", revision=2)
+        for n in fx.tpu_slice(
+            "v4-a",
+            hosts=hosts,
+            state=UpgradeState.UPGRADE_REQUIRED,
+            accelerator=V4,
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v2")
+        return fx
+
+    def test_pool_budget_deadlock(self):
+        cluster = FakeCluster()
+        self._pending_pool_fleet(cluster, hosts=4)
+        # Node units: the pool cap of 1 node can never admit a 4-host
+        # slice, even though the fleet budget (8) could.
+        policy = _policy(
+            unavailability_unit="node",
+            max_unavailable=IntOrString(8),
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    max_unavailable=IntOrString(1),
+                )
+            ],
+        )
+        mgr, state = _snapshot(cluster, policy)
+        reasons = find_infeasibilities(mgr, state, policy)
+        assert any(
+            r.startswith("budget-deadlock: pool v4") for r in reasons
+        )
+
+    def test_window_starvation_reason(self):
+        cluster = FakeCluster()
+        self._pending_pool_fleet(cluster)
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ],
+        )
+        mgr, state = _snapshot(cluster, policy)
+        reasons = find_infeasibilities(mgr, state, policy)
+        assert any(r.startswith("window-starvation") for r in reasons)
+
+    def test_elastic_decline_storm(self):
+        cluster = FakeCluster()
+        self._pending_pool_fleet(cluster)
+        policy = _policy()
+        mgr, state = _snapshot(cluster, policy)
+        mgr.elastic_negotiations = {
+            "decline": 3, "timeout": 2, "accept": 0,
+        }
+        reasons = find_infeasibilities(mgr, state, policy)
+        assert any(
+            r.startswith("elastic-decline-storm") for r in reasons
+        )
+        # One accept breaks the storm.
+        mgr.elastic_negotiations["accept"] = 1
+        assert not any(
+            r.startswith("elastic-decline-storm")
+            for r in find_infeasibilities(mgr, state, policy)
+        )
+
+    def test_healthy_fleet_reports_nothing(self):
+        cluster = FakeCluster()
+        self._pending_pool_fleet(cluster)
+        policy = _policy(max_unavailable=IntOrString("50%"))
+        mgr, state = _snapshot(cluster, policy)
+        assert find_infeasibilities(mgr, state, policy) == []
+
+
+# -- admission feasibility gate -----------------------------------------------
+
+
+class TestAdmissionFeasibility:
+    def test_zero_percent_fleet_budget_rejected(self):
+        policy = _policy(max_unavailable=IntOrString("0%"))
+        with pytest.raises(ValidationError, match="never start"):
+            policy.validate()
+
+    def test_zero_pool_budget_rejected(self):
+        policy = _policy(
+            pools=[
+                PoolSpec(name="v4", max_unavailable=IntOrString(0))
+            ]
+        )
+        with pytest.raises(ValidationError, match="pool 'v4'"):
+            policy.validate()
+
+    def test_never_opening_window_rejected(self):
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ]
+        )
+        with pytest.raises(ValidationError, match="never opens"):
+            policy.validate()
+
+    def test_planning_spec_knobs_validate(self):
+        policy = _policy(
+            planning=PlanningSpec(drift_threshold_second=-1)
+        )
+        with pytest.raises(ValidationError, match="driftThresholdSeconds"):
+            policy.validate()
+        good = _policy(
+            planning=PlanningSpec(
+                drift_threshold_second=120,
+                replan_interval_second=30,
+                max_replans=2,
+            )
+        )
+        good.validate()
+
+    def test_planning_spec_round_trips_camel_case(self):
+        spec = _policy(
+            planning=PlanningSpec(
+                drift_threshold_second=120, max_replans=2
+            )
+        )
+        data = spec.to_dict()
+        assert data["planning"]["driftThresholdSeconds"] == 120
+        back = TPUUpgradePolicySpec.from_dict(data)
+        assert back.planning.drift_threshold_second == 120
+        assert back.planning.max_replans == 2
+
+    def test_feasible_policy_admitted(self):
+        policy = _policy(
+            max_unavailable=IntOrString("25%"),
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    max_unavailable=IntOrString("50%"),
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron="* 0-6 * * 6,0"
+                    ),
+                )
+            ],
+        )
+        policy.validate()
+
+
+# -- runtime window-validation gap --------------------------------------------
+
+
+class TestWindowCronInvalid:
+    def _roll_with_cron(self, cron):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=1, hosts=2, accelerators=[V4])
+        # A malformed cron reaches the engine only by skipping admission
+        # (mid-run CR edit): build the spec without validate().
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(cron=cron),
+                )
+            ]
+        )
+        mgr = _manager(cluster)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        return mgr, state, policy
+
+    def test_fail_open_records_and_events_once(self):
+        mgr, state, policy = self._roll_with_cron("99 99 * * *")
+        assert mgr.window_cron_invalid == {"v4": "99 99 * * *"}
+        events = mgr.event_recorder.drain()
+        invalid = [
+            e for e in events if e.reason == "WindowCronInvalid"
+        ]
+        assert len(invalid) == 1
+        assert invalid[0].event_type == "Warning"
+        assert "failing OPEN" in invalid[0].message
+        # Fail-open means the roll actually starts.
+        assert mgr.pool_window_open == {"v4": True}
+        # Second pass: recorded but NOT re-evented.
+        state2 = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state2, policy)
+        mgr.wait_for_async_work(10.0)
+        assert not [
+            e
+            for e in mgr.event_recorder.drain()
+            if e.reason == "WindowCronInvalid"
+        ]
+
+    def test_metric_published_and_cleared(self):
+        mgr, state, _policy_ = self._roll_with_cron("99 99 * * *")
+        metrics = UpgradeMetrics(MetricsRegistry())
+        metrics.observe(mgr, state, 0.01)
+        text = metrics.registry.render()
+        assert 'tpu_operator_fleet_window_invalid{pool="v4"} 1' in text
+        # Cron fixed: the gauge series disappears.
+        mgr.window_cron_invalid.clear()
+        metrics.observe(mgr, state, 0.01)
+        assert "fleet_window_invalid{" not in metrics.registry.render()
+
+    def test_valid_cron_records_nothing(self):
+        mgr, _state, _p = self._roll_with_cron(ALWAYS_CRON)
+        assert mgr.window_cron_invalid == {}
+        assert not [
+            e
+            for e in mgr.event_recorder.drain()
+            if e.reason == "WindowCronInvalid"
+        ]
+
+
+# -- fleet-level stuck signal -------------------------------------------------
+
+
+class TestFleetInfeasibilitySignal:
+    def test_window_starved_roll_flagged_within_one_pass(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=2, hosts=2, accelerators=[V4])
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ]
+        )
+        mgr = _manager(cluster)
+        registry = MetricsRegistry()
+        mgr.stuck_detector.registry = registry
+        # ONE full pass must surface the starvation (acceptance
+        # criterion: within one resync interval).
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        reasons = mgr.stuck_detector.fleet_infeasibility
+        assert any(r.startswith("window-starvation") for r in reasons)
+        text = registry.render()
+        assert (
+            'fleet_roll_infeasible{reason="window-starvation"} 1' in text
+        )
+        events = mgr.event_recorder.drain()
+        assert any(e.reason == "RollInfeasible" for e in events)
+
+    def test_gauge_clears_when_roll_becomes_feasible(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=1, hosts=2, accelerators=[V4])
+        starved = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ]
+        )
+        mgr = _manager(cluster)
+        registry = MetricsRegistry()
+        mgr.stuck_detector.registry = registry
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, starved)
+        mgr.apply_state(state, starved)
+        mgr.wait_for_async_work(10.0)
+        assert "fleet_roll_infeasible" in registry.render()
+        open_policy = _policy()
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, open_policy)
+        mgr.apply_state(state, open_policy)
+        mgr.wait_for_async_work(10.0)
+        assert mgr.stuck_detector.fleet_infeasibility == []
+        assert "fleet_roll_infeasible{" not in registry.render()
+
+
+# -- digital twin -------------------------------------------------------------
+
+
+class TestDigitalTwin:
+    def test_twin_source_cluster_untouched(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=2, hosts=2)
+        policy = _policy(max_unavailable=IntOrString(1))
+        before = dict(cluster.stats)
+        write_prefixes = (
+            "patch", "create", "delete", "evict", "update", "post", "put",
+        )
+        result = run_twin(
+            cluster, NAMESPACE, DRIVER_LABELS, policy, keys=KEYS
+        )
+        assert result.converged
+        assert result.write_verbs > 0  # the CLONE was driven hard...
+        for verb, count in cluster.stats.items():  # ...the source not
+            if verb.lower().startswith(write_prefixes):
+                assert count == before.get(verb, 0), verb
+
+    def test_twin_holds_injected_preemptions(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=3, hosts=2)
+        policy = _policy(max_unavailable=IntOrString(3))
+        result = run_twin(
+            cluster,
+            NAMESPACE,
+            DRIVER_LABELS,
+            policy,
+            keys=KEYS,
+            preempt_groups={"pool-1"},
+        )
+        assert result.converged
+        admitted = {gid for wave in result.waves for gid in wave}
+        assert "pool-1" not in admitted
+        assert {"pool-0", "pool-2"} <= admitted
+
+
+# -- planner vs twin: seeded fuzz cross-check ---------------------------------
+
+
+class TestPlannerTwinAgreement:
+    """The acceptance criterion: the analytic wave schedule and the real
+    engine's admission batches agree exactly on mixed-generation fleets,
+    with and without injected faults."""
+
+    def _check(self, seed, preempt=False):
+        rng = random.Random(seed)
+        cluster = FakeCluster()
+        slices = rng.randint(3, 7)
+        accel_pool = [V4, V5E, V6E, "tpu-v5p-slice"]
+        accelerators = [rng.choice(accel_pool) for _ in range(slices)]
+        _outdated_fleet(
+            cluster, slices=slices, hosts=2, accelerators=accelerators
+        )
+        budget = rng.randint(1, 3)
+        policy = _policy(
+            max_unavailable=IntOrString(budget),
+            max_parallel_upgrades=rng.choice([0, budget]),
+        )
+        preempted = frozenset(
+            {f"pool-{rng.randrange(slices)}"} if preempt else ()
+        )
+        mgr, state = _snapshot(cluster, policy)
+        plan = plan_roll(
+            mgr,
+            state,
+            policy,
+            assumptions=PlanAssumptions(preempted_groups=preempted),
+        )
+        result = run_twin(
+            cluster,
+            NAMESPACE,
+            DRIVER_LABELS,
+            policy,
+            keys=KEYS,
+            preempt_groups=set(preempted),
+        )
+        assert result.converged, (seed, result.unfinished)
+        assert result.wave_count == plan.wave_count, (
+            seed,
+            [w.group_ids for w in plan.waves],
+            result.waves,
+        )
+        assert result.node_wave == plan.node_wave, seed
+        return plan, result
+
+    @pytest.mark.parametrize("seed", [11, 23, 37])
+    def test_clean_fleet_agreement(self, seed):
+        self._check(seed)
+
+    @pytest.mark.parametrize("seed", [41, 59])
+    def test_agreement_with_preempted_slice(self, seed):
+        self._check(seed, preempt=True)
+
+
+# -- drift watchdog -----------------------------------------------------------
+
+
+class TestDriftWatchdog:
+    def _fleet(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=3, hosts=2)
+        policy = _policy(max_unavailable=IntOrString(1))
+        mgr = _manager(cluster)
+        return cluster, mgr, policy
+
+    def _pass(self, mgr, policy):
+        """One full reconcile pass, then a fresh snapshot: state
+        transitions live on node labels, so the NEXT build reflects
+        them (the controller's tick N snapshot shows tick N-1's moves)."""
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(10.0)
+        return mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+
+    def test_anchors_once_and_measures_drift(self):
+        _cluster, mgr, policy = self._fleet()
+        dog = DriftWatchdog(KEYS, threshold_s=1e9)
+        t0 = 1_700_000_000.0
+        state = self._pass(mgr, policy)
+        report = dog.observe(mgr, state, policy, now=t0)
+        assert report.active and dog.plan is not None
+        anchored = dog.plan
+        first_due = min(
+            g.start_offset_s + g.duration_s for g in anchored.groups
+        )
+        # 100 s later with zero completions: exactly that much behind
+        # the first planned finish.
+        report = dog.observe(
+            mgr, state, policy, now=t0 + first_due + 100.0
+        )
+        assert dog.plan is anchored  # no re-plan under huge threshold
+        assert report.drift_seconds == pytest.approx(100.0)
+        assert report.projected_completion_epoch == pytest.approx(
+            anchored.projected_completion_epoch + 100.0
+        )
+
+    def test_replans_are_bounded(self):
+        _cluster, mgr, policy = self._fleet()
+        dog = DriftWatchdog(
+            KEYS, threshold_s=10.0, replan_interval_s=0.0, max_replans=2
+        )
+        t0 = 1_700_000_000.0
+        state = self._pass(mgr, policy)
+        dog.observe(mgr, state, policy, now=t0)
+        for i in range(5):
+            report = dog.observe(
+                mgr, state, policy, now=t0 + 10_000.0 * (i + 1)
+            )
+        assert report.replans == 2  # capped at max_replans
+        assert not report.replanned
+
+    def test_resets_when_roll_completes(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set(hash_suffix="v2", revision=2)
+        for n in fx.tpu_slice("done", hosts=2, state=UpgradeState.DONE):
+            fx.driver_pod(n, ds, hash_suffix="v2")
+        policy = _policy()
+        mgr = _manager(cluster)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        dog = DriftWatchdog(KEYS)
+        dog.plan = object()  # stale anchor from a finished roll
+        report = dog.observe(mgr, state, policy)
+        assert not report.active
+        assert dog.plan is None
+
+    def test_configure_adopts_policy_knobs(self):
+        dog = DriftWatchdog(KEYS)
+        dog.configure(
+            PlanningSpec(
+                drift_threshold_second=42,
+                replan_interval_second=7,
+                max_replans=1,
+            )
+        )
+        assert dog.threshold_s == 42.0
+        assert dog.replan_interval_s == 7.0
+        assert dog.max_replans == 1
+        dog.configure(None)  # None leaves everything as-is
+        assert dog.threshold_s == 42.0
+
+    def test_reports_infeasibility_from_live_snapshot(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set(hash_suffix="v2", revision=2)
+        for n in fx.tpu_slice(
+            "v4-a",
+            hosts=2,
+            state=UpgradeState.UPGRADE_REQUIRED,
+            accelerator=V4,
+        ):
+            fx.driver_pod(n, ds, hash_suffix="v2")
+        policy = _policy(
+            pools=[
+                PoolSpec(
+                    name="v4",
+                    node_selector={GKE_TPU_ACCELERATOR_LABEL: V4},
+                    maintenance_window=MaintenanceWindowSpec(
+                        cron=NEVER_CRON
+                    ),
+                )
+            ]
+        )
+        mgr = _manager(cluster)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        dog = DriftWatchdog(KEYS)
+        report = dog.observe(mgr, state, policy)
+        assert any(
+            r.startswith("window-starvation") for r in report.infeasible
+        )
+
+
+# -- controller integration: dry run + plan in CR status ----------------------
+
+
+class TestControllerPlanning:
+    def _controller(self, cluster):
+        return UpgradeController(
+            cluster,
+            ControllerConfig(
+                namespace=NAMESPACE,
+                driver_labels=dict(DRIVER_LABELS),
+                policy=_policy(max_unavailable=IntOrString(1)),
+                publish_events=False,
+            ),
+        )
+
+    def test_dry_run_zero_writes(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=3, hosts=2)
+        controller = self._controller(cluster)
+        baseline = controller._write_verb_count()
+        plan = controller.dry_run()
+        assert plan.wave_count == 3
+        assert controller._write_verb_count() == baseline
+        rendered = plan.render()
+        assert "RollPlan: 3 pending group(s)" in rendered
+        assert "wave 0" in rendered
+
+    def test_reconcile_publishes_plan_metrics(self):
+        cluster = FakeCluster()
+        _outdated_fleet(cluster, slices=2, hosts=2)
+        controller = self._controller(cluster)
+        # Tick 1 relabels the outdated fleet; tick 2's snapshot shows
+        # the active roll and the watchdog anchors its plan.
+        assert controller.reconcile_once()
+        assert controller.reconcile_once()
+        text = controller.registry.render()
+        assert "tpu_operator_plan_waves 2" in text
+        assert (
+            "tpu_operator_plan_projected_completion_timestamp_seconds"
+            in text
+        )
+        assert "tpu_operator_plan_drift_seconds" in text
+        report = controller.watchdog.last_report
+        assert report is not None and report.active
+        assert report.wave_count == 2
+
+    def test_plan_metrics_clear_after_completion(self):
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, KEYS)
+        ds = fx.daemon_set(hash_suffix="v2", revision=2)
+        for n in fx.tpu_slice("done", hosts=2, state=UpgradeState.DONE):
+            fx.driver_pod(n, ds, hash_suffix="v2")
+        controller = self._controller(cluster)
+        controller.registry.set("plan_waves", 5)  # stale
+        assert controller.reconcile_once()
+        # HELP/TYPE headers survive; the stale series itself must not.
+        assert "\ntpu_operator_plan_waves " not in (
+            controller.registry.render()
+        )
+
+
+# -- status CLI plan section --------------------------------------------------
+
+
+class TestStatusPlanSection:
+    METRICS = "\n".join(
+        [
+            "# HELP tpu_operator_plan_waves waves",
+            "tpu_operator_plan_waves 3",
+            "tpu_operator_plan_groups 6",
+            "tpu_operator_plan_completed_groups 2",
+            "tpu_operator_plan_projected_completion_timestamp_seconds"
+            " 1700003600",
+            "tpu_operator_plan_drift_seconds 42",
+            "tpu_operator_plan_replans_total 1",
+            'tpu_operator_fleet_roll_infeasible{reason="window-starvation"}'
+            " 1",
+            'tpu_operator_fleet_window_invalid{pool="v4"} 1',
+        ]
+    )
+
+    def test_plan_health_parses_families(self):
+        from k8s_operator_libs_tpu.status import plan_health
+
+        out = plan_health("http://x/metrics", fetch=lambda url: self.METRICS)
+        assert out == {
+            "waves": 3.0,
+            "plannedGroups": 6.0,
+            "completedGroups": 2.0,
+            "projectedCompletionEpoch": 1700003600.0,
+            "driftSeconds": 42.0,
+            "replans": 1.0,
+            "infeasible": ["window-starvation"],
+            "invalidWindows": ["v4"],
+        }
+
+    def test_plan_health_absent_when_no_active_roll(self):
+        from k8s_operator_libs_tpu.status import plan_health
+
+        # Only the monotonic replans counter left behind: no section.
+        text = "tpu_operator_plan_replans_total 1\n"
+        assert plan_health("http://x", fetch=lambda url: text) is None
+
+    def test_plan_health_unreachable_reports_error(self):
+        from k8s_operator_libs_tpu.status import plan_health
+
+        def boom(url):
+            raise OSError("connection refused")
+
+        out = plan_health("http://x", fetch=boom)
+        assert "error" in out
+
+    @staticmethod
+    def _base_status():
+        return {
+            "totalManagedNodes": 0,
+            "totalManagedGroups": 0,
+            "upgradesInProgress": 0,
+            "upgradesPending": 0,
+            "upgradesDone": 0,
+            "upgradesFailed": 0,
+            "groups": [],
+        }
+
+    def test_render_plan_section(self):
+        from k8s_operator_libs_tpu.status import plan_health, render
+
+        status = self._base_status()
+        status["plan"] = plan_health(
+            "http://x", fetch=lambda url: self.METRICS
+        )
+        text = render(status)
+        assert (
+            "plan: 2/6 group(s) done over 3 wave(s) | drift +42s"
+            " | replans 1 | ETA 2023-11-14T23:13:20Z" in text
+        )
+        assert "INFEASIBLE: window-starvation" in text
+        assert (
+            "invalid maintenance-window cron (failing open): v4" in text
+        )
+
+    def test_render_falls_back_to_cr_status_plan(self):
+        from k8s_operator_libs_tpu.status import render
+
+        status = self._base_status()
+        status["policy"] = {
+            "name": "rollout",
+            "plan": {
+                "planWaves": 2,
+                "planCompletedGroups": 1,
+                "planDriftSeconds": -5,
+                "planReplans": 0,
+                "projectedCompletion": "2026-01-01T00:00:00Z",
+            },
+        }
+        text = render(status)
+        assert "drift -5s" in text
+        assert "ETA 2026-01-01T00:00:00Z" in text

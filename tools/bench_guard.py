@@ -114,6 +114,18 @@ WH_IDLE_TICKS = 50
 WH_EVENT_STORM = 50
 WH_EVENT_COLLAPSE_FLOOR = 10.0
 
+# Planner stage: the predictive-planning pins.  A 4096-node
+# mixed-generation fleet must plan in under a second with exactly zero
+# API write verbs (planning is analytic — any write means a side effect
+# crept into the read path), and on a smaller mixed fleet the digital
+# twin (the REAL engine on a cloned cluster + accelerated clock) must
+# reproduce the analytic wave schedule exactly.
+PLANNER_N_SLICES = 256
+PLANNER_HOSTS_PER_SLICE = 16
+PLAN_WALL_CEILING_S = 1.0
+PLANNER_TWIN_N_SLICES = 12
+PLANNER_TWIN_HOSTS = 4
+
 
 def measure(
     slices: int = N_SLICES,
@@ -876,6 +888,131 @@ def measure_write_hygiene(
     }
 
 
+def measure_planner(
+    slices: int = PLANNER_N_SLICES,
+    hosts: int = PLANNER_HOSTS_PER_SLICE,
+    twin_slices: int = PLANNER_TWIN_N_SLICES,
+    twin_hosts: int = PLANNER_TWIN_HOSTS,
+) -> dict:
+    """Predictive-planning measurement; returns the artifact dict (also
+    embedded in BENCH_DETAILS.json by bench.py).
+
+    Sub-pins: a 4096-node mixed-generation plan lands under the wall
+    ceiling with exactly 0 API write verbs, and the digital twin's
+    actual admission schedule agrees with the analytic plan exactly
+    (wave count and node->wave assignment) on a smaller mixed fleet."""
+    import time
+
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.planning import plan_roll, run_twin
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    generations = [
+        "tpu-v4-podslice",
+        "tpu-v4-podslice",
+        "tpu-v5-lite-podslice",
+        "tpu-v6e-slice",
+    ]
+
+    def _writes(cluster) -> int:
+        return int(
+            sum(
+                v
+                for k, v in cluster.stats.items()
+                if str(k)
+                .lower()
+                .startswith(
+                    ("patch", "create", "delete", "evict", "update", "post", "put")
+                )
+            )
+        )
+
+    def _mixed_fleet(n_slices, n_hosts):
+        keys = UpgradeKeys()
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, keys)
+        ds = fx.daemon_set(hash_suffix="v1", revision=1)
+        for i in range(n_slices):
+            nodes = fx.tpu_slice(
+                f"pool-{i:03d}",
+                hosts=n_hosts,
+                state=UpgradeState.DONE,
+                accelerator=generations[i % len(generations)],
+            )
+            for n in nodes:
+                fx.driver_pod(n, ds, hash_suffix="v1")
+        fx.bump_daemon_set_template(ds, "v2", revision=2)
+        fx.auto_recreate_driver_pods(ds, "v2")
+        return keys, cluster
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString(4),
+        drain_spec=DrainSpec(enable=False),
+    )
+
+    # -- 1. plan wall time + write hygiene at 4096 nodes ---------------
+    keys, cluster = _mixed_fleet(slices, hosts)
+    manager = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    state = manager.build_state(NAMESPACE, DRIVER_LABELS, policy)
+    writes_before = _writes(cluster)
+    t0 = time.perf_counter()
+    plan = plan_roll(manager, state, policy)
+    plan_wall_s = time.perf_counter() - t0
+    plan_writes = _writes(cluster) - writes_before
+
+    # -- 2. twin-vs-analytic wave agreement on a smaller fleet ---------
+    twin_keys, twin_cluster = _mixed_fleet(twin_slices, twin_hosts)
+    twin_policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString(2),
+        drain_spec=DrainSpec(enable=False),
+    )
+    twin_manager = ClusterUpgradeStateManager(
+        twin_cluster,
+        keys=twin_keys,
+        poll_interval_s=0.005,
+        poll_timeout_s=2.0,
+    )
+    twin_state = twin_manager.build_state(
+        NAMESPACE, DRIVER_LABELS, twin_policy
+    )
+    analytic = plan_roll(twin_manager, twin_state, twin_policy)
+    twin = run_twin(
+        twin_cluster, NAMESPACE, DRIVER_LABELS, twin_policy, keys=twin_keys
+    )
+
+    return {
+        "stage": "planner",
+        "nodes": slices * hosts,
+        "pending_groups": plan.pending_groups,
+        "plan_waves": plan.wave_count,
+        "plan_wall_s": round(plan_wall_s, 4),
+        "plan_writes": plan_writes,
+        "wall_ceiling_s": PLAN_WALL_CEILING_S,
+        "twin_nodes": twin_slices * twin_hosts,
+        "twin_converged": twin.converged,
+        "analytic_waves": analytic.wave_count,
+        "twin_waves": twin.wave_count,
+        "node_wave_agrees": twin.node_wave == analytic.node_wave,
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -1087,6 +1224,39 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (write hygiene): {f}", file=sys.stderr)
+        return 1
+
+    planner = measure_planner()
+    failures = []
+    if planner["plan_wall_s"] > PLAN_WALL_CEILING_S:
+        failures.append(
+            f"{planner['nodes']}-node plan took "
+            f"{planner['plan_wall_s']}s (ceiling {PLAN_WALL_CEILING_S}s "
+            "— the analytic planner picked up a per-node API call or "
+            "quadratic scan)"
+        )
+    if planner["plan_writes"] != 0:
+        failures.append(
+            f"planning issued {planner['plan_writes']} API write "
+            "verb(s) (must be exactly 0 — planning is read-only)"
+        )
+    if not planner["twin_converged"]:
+        failures.append("digital twin did not converge to upgrade-done")
+    if planner["twin_waves"] != planner["analytic_waves"]:
+        failures.append(
+            f"twin executed {planner['twin_waves']} wave(s) but the "
+            f"analytic plan projected {planner['analytic_waves']} — "
+            "the planner's admission model diverged from the engine"
+        )
+    if not planner["node_wave_agrees"]:
+        failures.append(
+            "twin node->wave assignment diverged from the analytic plan"
+        )
+    planner["ok"] = not failures
+    print(json.dumps(planner, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (planner): {f}", file=sys.stderr)
         return 1
     return 0
 
