@@ -13,7 +13,7 @@ BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
 .PHONY: all test test-fast chaos lint typecheck cov-report bench \
 	bench-guard graft-check clean generate generate-check docker-build \
-	docker-push .build-image plan
+	docker-push .build-image plan whatif profile
 
 all: lint test
 
@@ -106,6 +106,18 @@ bench-guard:
 # to point it at a live CR.
 plan:
 	$(PYTHON) -m k8s_operator_libs_tpu.controller --dry-run $(ARGS)
+
+# What-if scoring: roll the digital twin under the current policy AND
+# under POLICY=<file>, print the makespan delta.  Same zero-write
+# contract as `make plan` — the live cluster sees only reads.
+whatif:
+	$(PYTHON) -m k8s_operator_libs_tpu.controller \
+		--score-policy $(POLICY) $(ARGS)
+
+# cProfile over one 256-node active-roll reconcile tick (top 25 by
+# cumulative time) — the first stop when bench-guard regresses.
+profile:
+	$(PYTHON) tools/profile_tick.py
 
 graft-check:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \

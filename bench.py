@@ -1352,6 +1352,7 @@ def main() -> None:
     from bench_guard import (  # noqa: E402
         measure_elastic as measure_elastic_roll,
         measure_heterogeneous as measure_heterogeneous_roll,
+        measure_packed_admission,
         measure_planner,
         measure_sharded as measure_sharded_reconcile,
         measure_write_hygiene,
@@ -1414,6 +1415,15 @@ def main() -> None:
     beat()
     log(f"planner (4096-node plan + twin agreement): {planner}")
 
+    # -- plan-guided admission packing (gated by `make bench-guard`) ---------
+    # Mixed-size 256-node roll under a node-unit budget no slice size
+    # divides: packed (FFD off the anchored plan) must beat greedy
+    # strictly on waves and makespan, the live engine's packed schedule
+    # must match the analytic plan, and budget-idle ticks stay 0.
+    packed_admission = measure_packed_admission()
+    beat()
+    log(f"packed admission (greedy vs FFD): {packed_admission}")
+
     complete = seq_result["complete"]
     details = {
         "complete": complete,
@@ -1470,6 +1480,7 @@ def main() -> None:
         "heterogeneous": heterogeneous,
         "write_hygiene": write_hygiene,
         "planner": planner,
+        "packed_admission": packed_admission,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1559,6 +1570,12 @@ def main() -> None:
         "write_hygiene_event_collapse": write_hygiene[
             "event_collapse_ratio"
         ],
+        "packed_vs_greedy_waves": [
+            packed_admission["packed_waves"],
+            packed_admission["greedy_waves"],
+        ],
+        "packed_engine_agrees": packed_admission["engine_plan_wave_agrees"],
+        "packed_idle_ticks": packed_admission["packed_idle_ticks"],
         "elastic_downtime_s": elastic_roll["downtime_s"],
         "elastic_max_gap_s": elastic_roll["max_gap_s"],
         "elastic_complete": elastic_roll["converged"],

@@ -32,6 +32,7 @@ from typing import Any, Union, get_args, get_origin, get_type_hints
 
 from k8s_operator_libs_tpu.api.v1alpha1 import (
     IntOrString,
+    PlanningSpec,
     SliceTopologySpec,
     TPUUpgradePolicySpec,
     _SpecBase,
@@ -78,6 +79,9 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("PlanningSpec", "drift_threshold_second"): {"minimum": 0},
     ("PlanningSpec", "replan_interval_second"): {"minimum": 0},
     ("PlanningSpec", "max_replans"): {"minimum": 0},
+    ("PlanningSpec", "admission_mode"): {
+        "enum": list(PlanningSpec.ADMISSION_MODES)
+    },
 }
 
 

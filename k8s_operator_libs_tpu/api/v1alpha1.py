@@ -494,6 +494,15 @@ class PlanningSpec(_SpecBase):
     # Ceiling on automatic re-plans per roll (planning must never
     # become the hot path on a pathological fleet).
     max_replans: int = 5
+    # How the admission pass orders chargeable groups.  "greedy" keeps
+    # the historical generation-then-id order; "packed" lets admission
+    # consult the watchdog's anchored plan and first-fit-decreasing
+    # pack each wave (falls back to greedy whenever no fresh plan is
+    # anchored).  Packing never relaxes budgets, DCN anti-affinity,
+    # maintenance windows, or oldest-generation-first ordering.
+    admission_mode: str = "greedy"
+
+    ADMISSION_MODES = ("greedy", "packed")
 
     def validate(self) -> None:
         if self.drift_threshold_second < 0:
@@ -506,6 +515,10 @@ class PlanningSpec(_SpecBase):
             )
         if self.max_replans < 0:
             raise ValidationError("planning.maxReplans must be >= 0")
+        if self.admission_mode not in self.ADMISSION_MODES:
+            raise ValidationError(
+                "planning.admissionMode must be 'greedy' or 'packed'"
+            )
 
 
 @dataclass

@@ -234,13 +234,30 @@ class UpgradeController:
         # active roll to its analytic RollPlan after every full pass and
         # republishes the ETA (CR status + metrics).  Planning is
         # read-only — it never issues a write verb.
+        from k8s_operator_libs_tpu.planning.clocks import PhaseClockTracker
         from k8s_operator_libs_tpu.planning.drift import DriftWatchdog
 
         self.watchdog = DriftWatchdog(self.keys)
+        # Per-pool EWMA phase clocks: every group-level transition the
+        # provider stages is also reported here (read-only observer), and
+        # the watchdog folds the measured clocks into each anchor/re-plan
+        # so projections tighten as the roll progresses.
+        self.clock_tracker = PhaseClockTracker()
+        self.watchdog.clock_tracker = self.clock_tracker
+        self.manager.provider.transition_observer = (
+            self.clock_tracker.observe_group_transition
+        )
+        # Plan-guided admission (planning.admissionMode: packed): the
+        # engine's admission pass consults the watchdog's fresh plan to
+        # ORDER chargeable groups — no budget/window/DCN gate is relaxed.
+        self.manager.drift_watchdog = self.watchdog
         if self._sharded is not None:
             # Scoped dirty ticks between full resyncs feed the watchdog
             # as progress evidence (read-only observer).
             self._sharded.progress_observer = self.watchdog.note_tick
+            # Budget-release wakeups target the planned-next wave first
+            # (blanket wake when no fresh plan).
+            self._sharded.plan_provider = self.watchdog.fresh_plan
         self.elector = None
         if config.leader_elect:
             from k8s_operator_libs_tpu.k8s.leader import (
@@ -348,6 +365,16 @@ class UpgradeController:
                 )
                 term = self.elector.term if self.elector is not None else 0
                 self.manager.adopt(state, identity=identity, term=term)
+                # Measured phase clocks ride the CR status: re-seed the
+                # EWMA on adoption so a restart or leader handoff does
+                # not reset estimates to the static defaults.  Loaded
+                # values never overwrite live samples.
+                if self._policy_cr is not None:
+                    self.clock_tracker.load_status(
+                        (self._policy_cr.get("status") or {}).get(
+                            "phaseClocks"
+                        )
+                    )
                 self._needs_adoption = False
                 self._adoptions += 1
                 self.registry.set(
@@ -362,6 +389,37 @@ class UpgradeController:
                 resync_started = self._sharded.observe_full_state(
                     state, self.config.policy, started=resync_t0
                 )
+            # Drift watchdog: full passes only (a scoped pass sees one
+            # pool and cannot measure fleet progress).  Read-only —
+            # plan_roll and find_infeasibilities never touch the API.
+            # Runs BEFORE apply_state so the plan anchored from this
+            # snapshot guides this pass's admission ordering (packed
+            # mode); every observe input is bucket-fixed at build_state
+            # time, so the verdict is identical either side of apply.
+            if self.config.policy is not None:
+                self.watchdog.configure(
+                    getattr(self.config.policy, "planning", None)
+                )
+                # Refresh node→pool attribution for the phase-clock
+                # tracker (full pass = whole-fleet scope), so measured
+                # durations are charged to the right pool's EWMA.
+                self.clock_tracker.seed_pools(
+                    {
+                        m.node.name: (
+                            self.manager._pool_for_group(
+                                g, self.config.policy
+                            )
+                            or ""
+                        )
+                        for g in state.all_groups()
+                        for m in g.members
+                    }
+                )
+                drift_report = self.watchdog.observe(
+                    self.manager, state, self.config.policy
+                )
+            else:
+                drift_report = None
             self.manager.apply_state(state, self.config.policy)
             if resync_started is not None:
                 # Deltas queued before this pass began are covered by it.
@@ -370,18 +428,6 @@ class UpgradeController:
         except CircuitOpenError as e:
             self._handle_circuit_open(e)
             return False
-        # Drift watchdog: full passes only (a scoped pass sees one pool
-        # and cannot measure fleet progress).  Read-only — plan_roll and
-        # find_infeasibilities never touch the API.
-        if self.config.policy is not None:
-            self.watchdog.configure(
-                getattr(self.config.policy, "planning", None)
-            )
-            drift_report = self.watchdog.observe(
-                self.manager, state, self.config.policy
-            )
-        else:
-            drift_report = None
         self.metrics.observe_plan(drift_report)
         if self.config.policy_ref is not None:
             self._update_cr_status(state)
@@ -439,6 +485,65 @@ class UpgradeController:
                 "must be read-only"
             )
         return plan
+
+    def score_policy(self, candidate_path: str) -> str:
+        """What-if scoring: run the digital twin under the CURRENT policy
+        and under the candidate policy file, and report the makespan
+        delta.  Same zero-write contract as --dry-run — both twins roll a
+        cloned fleet; the live cluster sees only reads."""
+        from k8s_operator_libs_tpu.planning.twin import run_twin
+
+        if self.config.policy_ref is not None:
+            self._refresh_policy_from_cr()
+        candidate = load_policy(candidate_path)
+        before = self._write_verb_count()
+        results = {}
+        for label, policy in (
+            ("current", self.config.policy),
+            ("candidate", candidate),
+        ):
+            results[label] = run_twin(
+                self.client,
+                self.config.namespace,
+                self.config.driver_labels,
+                policy,
+                keys=self.keys,
+            )
+        writes = self._write_verb_count() - before
+        if writes:
+            raise RuntimeError(
+                f"what-if scoring issued {writes} API write verb(s) "
+                "against the live cluster; scoring must be read-only"
+            )
+        cur, cand = results["current"], results["candidate"]
+        delta = cand.virtual_duration_s - cur.virtual_duration_s
+        lines = [
+            f"what-if: {candidate_path}",
+            (
+                f"  current:   makespan {cur.virtual_duration_s:10.1f}s"
+                f"  waves {cur.wave_count:3d}"
+                f"  converged {cur.converged}"
+            ),
+            (
+                f"  candidate: makespan {cand.virtual_duration_s:10.1f}s"
+                f"  waves {cand.wave_count:3d}"
+                f"  converged {cand.converged}"
+            ),
+            (
+                f"  delta:     {delta:+10.1f}s"
+                + (
+                    "  (candidate faster)"
+                    if delta < 0
+                    else ("  (candidate slower)" if delta > 0 else "")
+                )
+            ),
+        ]
+        if cur.held or cand.held:
+            lines.append(
+                f"  held groups: current {sorted(cur.held)} "
+                f"candidate {sorted(cand.held)}"
+            )
+        return "\n".join(lines)
 
     def _write_verb_count(self) -> float:
         """Write verbs observed so far: client per-verb stats (fake and
@@ -721,6 +826,20 @@ class UpgradeController:
                 status["planReplans"] = report.replans
                 if report.infeasible:
                     status["planInfeasible"] = list(report.infeasible)
+            # Measured per-pool phase clocks (EWMA): durable through the
+            # write plane so a successor controller adopts them instead
+            # of restarting from the static defaults.
+            phase_clocks = self.clock_tracker.to_status()
+            if phase_clocks:
+                status["phaseClocks"] = phase_clocks
+            astats = self.manager.admission_stats
+            if astats.get("last_budget_cap"):
+                status["admissionMode"] = self.manager.admission_mode
+                status["budgetSaturation"] = round(
+                    astats.get("last_budget_used", 0)
+                    / astats["last_budget_cap"],
+                    3,
+                )
             status["conditions"] = self._conditions(
                 status, (cr.get("status") or {}).get("conditions") or []
             )
@@ -1268,6 +1387,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         "infeasibility) and exit without issuing a single API write verb",
     )
     parser.add_argument(
+        "--score-policy",
+        default="",
+        metavar="FILE",
+        help="what-if scoring: run the digital twin under the current "
+        "policy and under FILE, print the makespan delta, and exit — "
+        "zero API write verbs against the live cluster (same contract "
+        "as --dry-run)",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="run leader election over a coordination.k8s.io Lease and "
@@ -1339,6 +1467,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     if args.dry_run:
         print(controller.dry_run().render())
+        return
+    if args.score_policy:
+        print(controller.score_policy(args.score_policy))
         return
     signal.signal(signal.SIGTERM, controller.stop)
     signal.signal(signal.SIGINT, controller.stop)

@@ -57,6 +57,22 @@ def order_groups(groups: Iterable) -> list:
     return sorted(groups, key=group_sort_key)
 
 
+def packed_group_sort_key(group, cost: int) -> tuple:
+    """First-fit-decreasing admission key (planning.admissionMode:
+    packed), shared by the analytic packer and the live admission pass.
+
+    The generation key stays primary — oldest-generation-first is
+    inviolable, so a younger generation is only ever *tried* after
+    every older group was tried (and admitted or found unchargeable).
+    Within a generation, larger groups go first so smaller ones fill
+    the residual budget instead of stranding it; id breaks ties for a
+    total deterministic order."""
+    accelerator = ""
+    if group.slice_info is not None:
+        accelerator = group.slice_info.accelerator or ""
+    return generation_order_key(accelerator) + (-cost, group.id)
+
+
 def pool_sort_key(
     accelerator_of: Callable[[str], Optional[str]],
 ) -> Callable[[str], tuple]:

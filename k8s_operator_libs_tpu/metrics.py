@@ -489,6 +489,41 @@ class UpgradeMetrics:
             "plan_replans_total",
             "Bounded re-plans triggered by drift over threshold",
         )
+        # Plan-guided admission surface (planning.admissionMode).
+        r.describe(
+            "admission_mode",
+            "1 for the admission ordering the engine used on its last "
+            "pass: packed (plan-guided first-fit-decreasing) or greedy "
+            "(generation/id order; also the fallback when no fresh plan "
+            "is anchored)",
+            "mode",
+        )
+        r.describe(
+            "budget_saturation",
+            "Fraction of the unavailability budget in use after the last "
+            "admission pass (used / cap)",
+        )
+        r.describe(
+            "budget_idle_ticks_total",
+            "Admission passes that ended with idle budget despite an "
+            "admissible group having been denied earlier in the same "
+            "pass — structurally 0; any increase is an admission bug",
+        )
+        r.describe(
+            "admission_packed_total",
+            "Groups admitted under packed (plan-guided) ordering",
+        )
+        r.describe(
+            "budget_wakeups_targeted_total",
+            "Budget-release wakeups routed to the planned-next wave's "
+            "pools only (vs blanket-waking every denied waiter)",
+        )
+        r.describe(
+            "budget_wakeups_deferred_total",
+            "Denied waiters re-queued (not woken) by a targeted "
+            "budget-release wakeup; they re-enter on the next release "
+            "or full resync",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -554,6 +589,25 @@ class UpgradeMetrics:
         if esc_stats is not None and hasattr(esc_stats, "snapshot"):
             for rung, count in sorted(esc_stats.snapshot().items()):
                 r.set("eviction_escalations_total", count, rung=rung)
+        # Plan-guided admission surface (absent on injected fakes).
+        astats = getattr(manager, "admission_stats", None)
+        if astats is not None:
+            cap = astats.get("last_budget_cap", 0)
+            if cap:
+                r.set(
+                    "budget_saturation",
+                    astats.get("last_budget_used", 0) / cap,
+                )
+            r.set(
+                "budget_idle_ticks_total",
+                astats.get("budget_idle_ticks", 0),
+            )
+            r.set(
+                "admission_packed_total", astats.get("packed_admitted", 0)
+            )
+            mode = getattr(manager, "admission_mode", "greedy")
+            r.clear("admission_mode")
+            r.set("admission_mode", 1.0, mode=mode)
         # Client resilience surface (present on RestClient and
         # ResilientClient; absent on a bare FakeCluster).
         client = getattr(manager, "client", None)
@@ -811,6 +865,14 @@ class UpgradeMetrics:
         r.set("dirty_shard_errors_total", sstats.get("shard_errors", 0))
         r.set("dirty_shard_fenced_total", sstats.get("fenced", 0))
         r.set("full_resyncs_total", sstats.get("full_resyncs", 0))
+        r.set(
+            "budget_wakeups_targeted_total",
+            sstats.get("budget_wakeups_targeted", 0),
+        )
+        r.set(
+            "budget_wakeups_deferred_total",
+            sstats.get("budget_wakeups_deferred", 0),
+        )
         ledger = sharded.ledger
         r.set("budget_unavailable_used", ledger.unavailable_used())
         r.set("budget_unavailable_cap", ledger.max_unavailable)

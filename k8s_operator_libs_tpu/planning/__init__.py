@@ -13,6 +13,15 @@ Three cooperating read-only parts:
 - :mod:`drift` — live drift watchdog: anchors an active roll to its
   admitted plan, republishes the ETA every tick, and triggers a bounded
   re-plan when reality diverges beyond a threshold.
+- :mod:`clocks` — per-pool EWMA phase clocks measured from observed
+  transitions, feeding the watchdog's re-plans (and serialized through
+  CR status so estimates survive controller failover).
+
+The one write-adjacent consumer is plan-GUIDED admission
+(``planning.admissionMode: packed``): the engine's admission pass reads
+the watchdog's fresh plan to order chargeable groups
+(first-fit-decreasing within each generation class) — planning itself
+still never writes.
 
 See docs/rollout-planning.md.
 """
@@ -33,4 +42,7 @@ from k8s_operator_libs_tpu.planning.twin import (  # noqa: F401
 from k8s_operator_libs_tpu.planning.drift import (  # noqa: F401
     DriftReport,
     DriftWatchdog,
+)
+from k8s_operator_libs_tpu.planning.clocks import (  # noqa: F401
+    PhaseClockTracker,
 )

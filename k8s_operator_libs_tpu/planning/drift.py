@@ -23,7 +23,7 @@ plan-infeasible, not silence.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 from k8s_operator_libs_tpu.consts import get_logger
@@ -43,6 +43,11 @@ logger = get_logger(__name__)
 DEFAULT_DRIFT_THRESHOLD_S = 300.0
 DEFAULT_REPLAN_INTERVAL_S = 60.0
 DEFAULT_MAX_REPLANS = 5
+# A plan older than this no longer guides admission (packed mode falls
+# back to greedy until the next anchor/re-plan refreshes it).  Generous
+# vs the re-plan cadence: any healthy watchdog re-anchors well inside
+# it; only a stalled watchdog leaves a plan to age out.
+DEFAULT_PLAN_STALENESS_S = 600.0
 
 
 @dataclass
@@ -80,7 +85,14 @@ class DriftWatchdog:
         self.plan: Optional[RollPlan] = None
         self.replans = 0
         self._last_replan_epoch = 0.0
+        self._last_observe_epoch = 0.0
         self.last_report: Optional[DriftReport] = None
+        # Freshness bound for fresh_plan() (plan-guided admission).
+        self.plan_staleness_s = DEFAULT_PLAN_STALENESS_S
+        # Optional PhaseClockTracker (planning/clocks.py): when set,
+        # every anchor/re-plan folds its per-pool EWMA estimates into
+        # the assumptions so projections tighten as the roll runs.
+        self.clock_tracker = None
         # Scoped-pass activity fed by ShardedReconciler.progress_observer
         # (dirty ticks between full resyncs): evidence the engine is
         # working the plan even when no full pass has run yet.
@@ -104,12 +116,57 @@ class DriftWatchdog:
             planning_spec.replan_interval_second
         )
         self.max_replans = int(planning_spec.max_replans)
+        # A fresh plan must outlive at least one threshold+re-plan
+        # cycle, but never shrink below the default admission window.
+        self.plan_staleness_s = max(
+            DEFAULT_PLAN_STALENESS_S,
+            self.threshold_s + self.replan_interval_s,
+        )
 
     def reset(self) -> None:
         """Drop the anchor (roll finished, or policy changed)."""
         self.plan = None
         self.replans = 0
         self._last_replan_epoch = 0.0
+        self._last_observe_epoch = 0.0
+
+    def fresh_plan(self, now: Optional[float] = None) -> Optional[RollPlan]:
+        """The anchored plan IF the watchdog is still maintaining it.
+
+        Freshness is measured from the last active ``observe`` pass,
+        not plan creation — a healthy long roll keeps its anchor fresh
+        every full pass, while a wedged controller lets it age out.
+        Returns None when stale: packed admission and targeted wakeups
+        must fall back to greedy/blanket behavior rather than chase a
+        projection nobody is validating."""
+        if self.plan is None:
+            return None
+        now = time.time() if now is None else now
+        if now - self._last_observe_epoch > self.plan_staleness_s:
+            return None
+        return self.plan
+
+    def _plan_assumptions(self) -> Optional[PlanAssumptions]:
+        """Assumptions for an anchor/re-plan, with the clock tracker's
+        measured per-pool EWMA folded in when any samples exist."""
+        base = self.assumptions
+        tracker = self.clock_tracker
+        if tracker is None:
+            return base
+        try:
+            pool_clocks = tracker.pool_clocks(
+                base.clocks if base is not None else None
+            )
+        except Exception:  # never let telemetry break planning
+            logger.exception("drift watchdog: clock tracker failed")
+            return base
+        if not pool_clocks:
+            return base
+        if base is None:
+            return PlanAssumptions(pool_clocks=pool_clocks)
+        merged = dict(pool_clocks)
+        merged.update(base.pool_clocks)  # explicit what-ifs win
+        return replace(base, pool_clocks=merged)
 
     def _roll_active(self, state, manager=None) -> bool:
         if state.groups_in(UpgradeState.UPGRADE_REQUIRED):
@@ -137,11 +194,12 @@ class DriftWatchdog:
             self.last_report = report
             return report
         report.active = True
+        self._last_observe_epoch = now
 
         if self.plan is None:
             self.plan = plan_roll(
                 manager, state, policy, now=now,
-                assumptions=self.assumptions,
+                assumptions=self._plan_assumptions(),
             )
             self._last_replan_epoch = now
             logger.info(
@@ -192,7 +250,7 @@ class DriftWatchdog:
         ):
             self.plan = plan_roll(
                 manager, state, policy, now=now,
-                assumptions=self.assumptions,
+                assumptions=self._plan_assumptions(),
             )
             self.replans += 1
             self._last_replan_epoch = now

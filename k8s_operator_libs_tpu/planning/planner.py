@@ -30,7 +30,10 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from k8s_operator_libs_tpu.consts import get_logger
-from k8s_operator_libs_tpu.fleet.scheduler import group_sort_key
+from k8s_operator_libs_tpu.fleet.scheduler import (
+    group_sort_key,
+    packed_group_sort_key,
+)
 from k8s_operator_libs_tpu.fleet.windows import (
     NEXT_OPEN_HORIZON_S,
     next_open,
@@ -107,6 +110,14 @@ class PlanAssumptions:
 
     elastic_answer: str = "accept"  # accept | decline | timeout
     clocks: PhaseClocks = field(default_factory=PhaseClocks)
+    # Measured per-pool clocks (pool name -> PhaseClocks, "" for the
+    # pool-less bucket) overriding ``clocks`` for that pool's groups —
+    # the drift watchdog feeds the EWMA tracker's estimates in here so
+    # re-plans tighten as the roll progresses.
+    pool_clocks: dict = field(default_factory=dict)
+    # Wave-ordering override: "" inherits planning.admissionMode from
+    # the policy; "greedy"/"packed" force one packer for what-ifs.
+    admission_mode: str = ""
     # Group ids assumed preempted for the projection (what-if knob; the
     # live preemption annotation is honored regardless).
     preempted_groups: frozenset = frozenset()
@@ -180,21 +191,30 @@ class RollPlan:
     projected_duration_s: float = 0.0
     projected_completion_epoch: float = 0.0
     unit: str = "slice"
+    # Wave-ordering the projection was packed under (greedy | packed).
+    admission_mode: str = "greedy"
+    # Lazy group->wave index: packed admission asks wave_of once per
+    # pending group per pass, which must stay O(1) amortized.
+    _wave_index: Optional[dict] = field(
+        default=None, repr=False, compare=False
+    )
 
     @property
     def wave_count(self) -> int:
         return len(self.waves)
 
     def wave_of(self, group_id: str) -> Optional[int]:
-        for g in self.groups:
-            if g.group_id == group_id:
-                return g.wave
-        return None
+        if self._wave_index is None or len(self._wave_index) != len(
+            self.groups
+        ):
+            self._wave_index = {g.group_id: g.wave for g in self.groups}
+        return self._wave_index.get(group_id)
 
     def to_dict(self) -> dict:
         return {
             "createdEpoch": int(self.created_epoch),
             "unit": self.unit,
+            "admissionMode": self.admission_mode,
             "totalNodes": self.total_nodes,
             "pendingGroups": self.pending_groups,
             "waveCount": len(self.waves),
@@ -238,11 +258,17 @@ class RollPlan:
 
 
 def _group_duration_s(
-    group, policy, assumptions: PlanAssumptions, elastic_candidate: bool
+    group,
+    policy,
+    assumptions: PlanAssumptions,
+    elastic_candidate: bool,
+    pool_name: Optional[str] = None,
 ) -> float:
     """Projected wall-clock for one group's pass through the disruptive
-    states, from the assumption clocks + the policy's enabled phases."""
-    clocks = assumptions.clocks
+    states, from the assumption clocks + the policy's enabled phases.
+    Pools with measured EWMA clocks use those; the rest fall back to
+    the assumption-level (static or twin-measured) clocks."""
+    clocks = assumptions.pool_clocks.get(pool_name or "") or assumptions.clocks
     total = clocks.cordon_s + clocks.uncordon_s + clocks.pod_restart_s
     total += clocks.validation_s
     if policy.wait_for_completion is not None:
@@ -528,7 +554,9 @@ def plan_roll(
             )
             continue
         elastic = _elastic_candidate(manager, policy, group)
-        duration = _group_duration_s(group, policy, assumptions, elastic)
+        duration = _group_duration_s(
+            group, policy, assumptions, elastic, pool_name
+        )
         if eff in IN_PROGRESS_STATES:
             in_flight.append(
                 (group, pool_name, _cost(group), elastic, duration)
@@ -543,7 +571,20 @@ def plan_roll(
                     (group, pool_name, _cost(group), elastic, duration)
                 )
 
-    pending.sort(key=lambda item: group_sort_key(item[0]))
+    admission_mode = assumptions.admission_mode or getattr(
+        getattr(policy, "planning", None), "admission_mode", ""
+    )
+    plan.admission_mode = admission_mode or "greedy"
+    if admission_mode == "packed":
+        # First-fit-decreasing within each generation class: the wave
+        # loop below is already first-fit (denied groups stay pending
+        # while later ones fill the residual budget), so the decreasing
+        # cost order is all packing adds — no gate is relaxed.
+        pending.sort(
+            key=lambda item: packed_group_sort_key(item[0], item[2])
+        )
+    else:
+        pending.sort(key=lambda item: group_sort_key(item[0]))
     plan.pending_groups = len(pending) + len(in_flight)
 
     # -- simulate admission waves ---------------------------------------

@@ -100,6 +100,11 @@ class TwinResult:
     held: list[str] = field(default_factory=list)
     elastic_negotiations: dict[str, int] = field(default_factory=dict)
     write_verbs: int = 0
+    # Engine admission telemetry: the ordering mode actually used over
+    # the roll (packed requires a fresh anchored plan) and the
+    # cumulative manager.admission_stats counters.
+    admission_mode: str = "greedy"
+    admission: dict = field(default_factory=dict)
 
     @property
     def wave_count(self) -> int:
@@ -230,6 +235,23 @@ def run_twin(
             twin, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
         )
         _install_kubelet(twin, mgr)
+        # Plan-guided admission needs the same wiring the controller
+        # has: a drift watchdog anchored before each apply so packed
+        # mode orders admission off a fresh plan.  Greedy twins skip it
+        # (the engine would ignore the plan and per-tick observe costs
+        # a plan_roll + find_infeasibilities).
+        watchdog = None
+        planning_spec = getattr(policy, "planning", None)
+        if (
+            planning_spec is not None
+            and getattr(planning_spec, "admission_mode", "greedy")
+            == "packed"
+        ):
+            from k8s_operator_libs_tpu.planning.drift import DriftWatchdog
+
+            watchdog = DriftWatchdog(keys)
+            watchdog.configure(planning_spec)
+            mgr.drift_watchdog = watchdog
 
         sharded_reconciler = None
         if sharded:
@@ -261,6 +283,10 @@ def run_twin(
         while tick < max_ticks and clock.now() - t0 <= max_virtual_s:
             tick += 1
             state = mgr.build_state(namespace, driver_labels, policy)
+            if watchdog is not None:
+                # Mirror reconcile_once: anchor/refresh the plan from
+                # this snapshot BEFORE acting on it.
+                watchdog.observe(mgr, state, policy, now=clock.now())
             if sharded_reconciler is not None:
                 started = sharded_reconciler.observe_full_state(
                     state, policy, started=clock.now()
@@ -302,6 +328,13 @@ def run_twin(
         result.virtual_duration_s = clock.now() - t0
         result.write_verbs = _write_verbs(twin) - writes_before
         result.elastic_negotiations = dict(mgr.elastic_negotiations)
+        # The final tick sees an inactive roll (plan dropped), so the
+        # live mode flag has already fallen back — report packed if any
+        # admission during the roll actually used the packed ordering.
+        result.admission = dict(mgr.admission_stats)
+        result.admission_mode = (
+            "packed" if result.admission.get("packed_admitted") else "greedy"
+        )
 
         # Assemble waves from admission ticks.
         by_tick: dict[int, list[str]] = {}

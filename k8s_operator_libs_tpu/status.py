@@ -98,6 +98,20 @@ PLAN_METRIC_KEYS = {
     "plan_projected_completion_timestamp_seconds": "projectedCompletionEpoch",
     "plan_drift_seconds": "driftSeconds",
     "plan_replans_total": "replans",
+    "budget_saturation": "budgetSaturation",
+    "budget_idle_ticks_total": "budgetIdleTicks",
+    "admission_packed_total": "packedAdmissions",
+}
+
+# Admission keys are published even with no active roll, so (like
+# "replans") they must not by themselves make plan_health report a
+# section.
+_PLAN_ALWAYS_ON_KEYS = {
+    "replans",
+    "budgetSaturation",
+    "budgetIdleTicks",
+    "packedAdmissions",
+    "admissionMode",
 }
 
 
@@ -330,6 +344,10 @@ def plan_health(metrics_url: str, fetch=None) -> Optional[dict]:
             reason = labels.split('reason="', 1)
             if len(reason) == 2 and val:
                 infeasible.append(reason[1].split('"', 1)[0])
+        elif short == "admission_mode":
+            mode = labels.split('mode="', 1)
+            if len(mode) == 2 and val:
+                out["admissionMode"] = mode[1].split('"', 1)[0]
         elif short == "fleet_window_invalid":
             pool = labels.split('pool="', 1)
             if len(pool) == 2 and val:
@@ -342,9 +360,10 @@ def plan_health(metrics_url: str, fetch=None) -> Optional[dict]:
         out["infeasible"] = sorted(infeasible)
     if invalid_windows:
         out["invalidWindows"] = sorted(invalid_windows)
-    # plan_replans_total alone is published even with no active roll —
-    # require a wave/ETA series before reporting a section.
-    return out if set(out) - {"replans"} else None
+    # plan_replans_total and the admission keys are published even with
+    # no active roll — require a wave/ETA series before reporting a
+    # section.
+    return out if set(out) - _PLAN_ALWAYS_ON_KEYS else None
 
 
 def gather(
@@ -405,6 +424,8 @@ def gather(
                     "planCompletedGroups",
                     "planReplans",
                     "planInfeasible",
+                    "admissionMode",
+                    "budgetSaturation",
                 )
                 if key in cr_status
             }
@@ -841,6 +862,10 @@ def render(status: dict) -> str:
                 ),
                 "infeasible": cr_plan.get("planInfeasible") or [],
             }
+            if "admissionMode" in cr_plan:
+                plan["admissionMode"] = cr_plan["admissionMode"]
+            if "budgetSaturation" in cr_plan:
+                plan["budgetSaturation"] = cr_plan["budgetSaturation"]
     if plan is not None:
         lines.append("")
         if "error" in plan:
@@ -863,6 +888,24 @@ def render(status: dict) -> str:
                 f" | replans {int(plan.get('replans', 0))}"
                 + (f" | ETA {eta}" if eta else "")
             )
+            mode = plan.get("admissionMode")
+            if mode:
+                admission = f"  admission: {mode}"
+                if "budgetSaturation" in plan:
+                    admission += (
+                        " | budget "
+                        f"{float(plan['budgetSaturation']) * 100:.0f}%"
+                        " saturated"
+                    )
+                if "budgetIdleTicks" in plan:
+                    admission += (
+                        f" | idle ticks {int(plan['budgetIdleTicks'])}"
+                    )
+                if "packedAdmissions" in plan:
+                    admission += (
+                        f" | packed {int(plan['packedAdmissions'])}"
+                    )
+                lines.append(admission)
             for reason in plan.get("infeasible") or []:
                 lines.append(f"  INFEASIBLE: {reason}")
             invalid = plan.get("invalidWindows") or []

@@ -101,6 +101,12 @@ class NodeUpgradeStateProvider:
         self.plan = plan or WritePlan(
             client, max_concurrency=max_concurrency
         )
+        # Phase-clock telemetry (planning/clocks.py): called once per
+        # GROUP transition with (nodes, new_state) BEFORE the new labels
+        # are staged — change_nodes_upgrade_state is the one choke point
+        # every group-level transition goes through.  Read-only; a
+        # failing observer must never block a transition.
+        self.transition_observer = None
 
     # -- write coalescing ----------------------------------------------------
 
@@ -304,6 +310,11 @@ class NodeUpgradeStateProvider:
         Raises on the first failure after all attempts complete, so a
         partially-written slice is re-driven by the next idempotent pass
         (the group's effective_state resolves to the earliest member)."""
+        if self.transition_observer is not None and nodes:
+            try:
+                self.transition_observer(nodes, new_state)
+            except Exception:
+                logger.exception("transition observer failed; continuing")
         if self.plan.in_scope():
             # Inside a coalescing scope: fanning out to worker threads
             # would leave this thread's scope behind, so stage in-line

@@ -366,6 +366,60 @@ class BudgetLedger:
             for g, d in self._dcn_of.items()
         )
 
+    def _denied_locked(
+        self,
+        group_id: str,
+        cost: int,
+        dcn_group: Optional[str],
+        pool: Optional[str],
+    ) -> bool:
+        """Every admission gate in order: DCN anti-affinity, fleet
+        parallel, fleet budget, then the pool's own caps.  Caller holds
+        the lock; shared by try_claim and the read-only can_claim."""
+        if dcn_group is not None and self._dcn_held_by_other(
+            group_id, dcn_group
+        ):
+            return True
+        if (
+            self.max_parallel > 0
+            and len(self._charges) >= self.max_parallel
+        ):
+            return True
+        used = sum(self._charges.values()) + self.external_unavailable
+        if used + cost > self.max_unavailable:
+            return True
+        if pool is not None:
+            caps = self._pool_caps.get(pool)
+            if caps is not None:
+                pool_max_unavailable, pool_max_parallel = caps
+                pool_used, pool_count = self._pool_usage(pool)
+                if (
+                    pool_max_parallel > 0
+                    and pool_count >= pool_max_parallel
+                ):
+                    return True
+                if pool_used + cost > pool_max_unavailable:
+                    return True
+        return False
+
+    def can_claim(
+        self,
+        group_id: str,
+        cost: int,
+        dcn_group: Optional[str] = None,
+        pool: Optional[str] = None,
+    ) -> bool:
+        """Read-only probe: would ``try_claim`` succeed right now?
+        Never charges and never registers a waiter — the admission
+        pass's idle-budget canary and the targeted wakeup path use it
+        to ask without committing."""
+        if pool is None and self.pool_resolver is not None:
+            pool = self.pool_resolver(group_id)
+        with self._lock:
+            if group_id in self._charges:
+                return True
+            return not self._denied_locked(group_id, cost, dcn_group, pool)
+
     def try_claim(
         self,
         group_id: str,
@@ -392,36 +446,7 @@ class BudgetLedger:
                     self._pool_of_charge[group_id] = pool
                 return True
             if not force:
-                denied = False
-                if dcn_group is not None and self._dcn_held_by_other(
-                    group_id, dcn_group
-                ):
-                    denied = True
-                elif (
-                    self.max_parallel > 0
-                    and len(self._charges) >= self.max_parallel
-                ):
-                    denied = True
-                else:
-                    used = (
-                        sum(self._charges.values())
-                        + self.external_unavailable
-                    )
-                    if used + cost > self.max_unavailable:
-                        denied = True
-                if not denied and pool is not None:
-                    caps = self._pool_caps.get(pool)
-                    if caps is not None:
-                        pool_max_unavailable, pool_max_parallel = caps
-                        pool_used, pool_count = self._pool_usage(pool)
-                        if (
-                            pool_max_parallel > 0
-                            and pool_count >= pool_max_parallel
-                        ):
-                            denied = True
-                        elif pool_used + cost > pool_max_unavailable:
-                            denied = True
-                if denied:
+                if self._denied_locked(group_id, cost, dcn_group, pool):
                     self._waiters.add(group_id)
                     return False
             self._charges[group_id] = cost
@@ -445,6 +470,19 @@ class BudgetLedger:
         # lock) and may wake the controller.
         if waiters and self.on_release is not None:
             self.on_release(waiters)
+
+    def requeue_waiters(self, group_ids) -> None:
+        """Re-register waiters a targeted wakeup chose NOT to wake.
+
+        ``release`` swaps the whole waiter set out before the callback
+        runs; a plan-guided callback wakes only the planned-next groups
+        and hands the rest back here so the following release considers
+        them again (already-charged groups are dropped — they are no
+        longer waiting)."""
+        with self._lock:
+            self._waiters.update(
+                g for g in group_ids if g not in self._charges
+            )
 
     # -- introspection -------------------------------------------------------
 
@@ -671,24 +709,76 @@ class ShardedReconciler:
         # scoped-pass activity between full resyncs without polling the
         # queue.  Read-only consumer; exceptions must not kill the tick.
         self.progress_observer: Optional[Callable[[TickReport], None]] = None
+        # Plan-guided wakeups: returns the drift watchdog's FRESH plan
+        # (or None).  With a plan, a budget release re-dirties only the
+        # earliest-planned waiters' pools and requeues the rest, so
+        # freed budget goes to the group the plan says is next instead
+        # of whichever denied pool's shard wins the race.
+        self.plan_provider: Optional[Callable[[], Optional[object]]] = None
 
     # -- feed ----------------------------------------------------------------
 
     def handle_event(self, ev: Optional[WatchEvent]) -> None:
         self.router.route(ev)
 
+    def _planned_next_waiters(self, waiter_ids: set[str]) -> set[str]:
+        """The subset of ``waiter_ids`` in the fresh plan's earliest
+        wave among those present; all of them when no fresh plan (or
+        none of the waiters is planned — liveness over packing)."""
+        if self.plan_provider is None:
+            return waiter_ids
+        try:
+            plan = self.plan_provider()
+        except Exception:
+            logger.exception("plan provider failed; blanket wakeup")
+            return waiter_ids
+        if plan is None:
+            return waiter_ids
+        waves: dict[str, int] = {}
+        for gid in waiter_ids:
+            wave = plan.wave_of(gid)
+            if wave is not None:
+                waves[gid] = wave
+        if not waves:
+            return waiter_ids
+        first = min(waves.values())
+        return {gid for gid, wave in waves.items() if wave == first}
+
     def _on_budget_release(self, waiter_ids: set[str]) -> None:
         """Budget freed: re-dirty the pools of groups that were denied a
         claim.  Without this a fleet roll stalls after the first
         ``maxUnavailable`` batch — a pool that is merely waiting its
         turn emits no watch events, so only the (slow) full resync
-        would ever retry it."""
+        would ever retry it.
+
+        With a fresh anchored plan the wakeup is TARGETED: only the
+        planned-next wave's waiters are re-dirtied (the freed budget is
+        theirs by the plan); the rest go back on the waiter list via
+        ``requeue_waiters`` for the next release.  Any routing failure
+        falls back to waking everything — a stale plan may cost a pool
+        walk, never a stall."""
+        targeted = self._planned_next_waiters(waiter_ids)
         marked = 0
-        for gid in waiter_ids:
+        for gid in targeted:
             pool = self.router.pool_of_group(gid)
             if pool is not None:
                 self.queue.mark(pool)
                 marked += 1
+        deferred = waiter_ids - targeted
+        if marked == 0 and deferred:
+            # Targeted set unroutable (pool registry raced a resync):
+            # blanket-wake rather than strand the roll.
+            for gid in deferred:
+                pool = self.router.pool_of_group(gid)
+                if pool is not None:
+                    self.queue.mark(pool)
+                    marked += 1
+            deferred = set()
+        if deferred:
+            self.ledger.requeue_waiters(deferred)
+            self.stats["budget_wakeups_deferred"] += len(deferred)
+        if targeted is not waiter_ids:
+            self.stats["budget_wakeups_targeted"] += marked
         self.stats["budget_wakeups"] += marked
         if marked and self.wake is not None:
             self.wake()

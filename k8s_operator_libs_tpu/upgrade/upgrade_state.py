@@ -32,7 +32,10 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
 )
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.fleet.profiles import generation_of
-from k8s_operator_libs_tpu.fleet.scheduler import group_sort_key
+from k8s_operator_libs_tpu.fleet.scheduler import (
+    group_sort_key,
+    packed_group_sort_key,
+)
 from k8s_operator_libs_tpu.fleet.windows import window_open
 from k8s_operator_libs_tpu.k8s.client import NotFoundError
 from k8s_operator_libs_tpu.k8s.drain import (
@@ -309,6 +312,19 @@ class ClusterUpgradeStateManager:
         # the WindowCronInvalid Warning to once per fail-open episode.
         self.window_cron_invalid: dict[str, str] = {}
         self._window_invalid_emitted: set[str] = set()
+        # Plan-guided admission (planning.admissionMode: packed): the
+        # controller wires its DriftWatchdog here; the admission pass
+        # consults watchdog.fresh_plan() to pack waves and falls back to
+        # greedy order whenever no fresh plan is anchored.
+        self.drift_watchdog = None
+        # Admission telemetry for metrics/status: lifetime counters
+        # (packed_admitted, budget_idle_ticks) plus last-pass gauges
+        # (last_budget_used / last_budget_cap -> budget_saturation).
+        self.admission_stats: dict[str, int] = {}
+        # Mode the last admission pass actually ran under ("greedy" or
+        # "packed" — packed requires a fresh plan, so a stale anchor
+        # reports greedy here even with admissionMode: packed).
+        self.admission_mode = "greedy"
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -792,6 +808,8 @@ class ClusterUpgradeStateManager:
 
         unit = self._unavailability_unit(policy)
         ledger = self.budget_ledger
+        in_progress_units = 0
+        max_unavailable = 0
         if ledger is not None:
             # Sharded mode: the fleet-wide ledger (re-baselined every
             # full resync) is the single arbiter; the scoped state's
@@ -816,10 +834,11 @@ class ClusterUpgradeStateManager:
                 current_state, policy.max_parallel_upgrades, max_unavailable,
                 unit, pipeline=pipeline,
             )
+            in_progress_units = self._in_progress_units(current_state, unit)
             logger.info(
                 "upgrades in progress: %d, available slots: %d (unit=%s, "
                 "maxUnavailable=%d, total=%d)",
-                self._in_progress_units(current_state, unit),
+                in_progress_units,
                 upgrades_available,
                 unit,
                 max_unavailable,
@@ -831,6 +850,18 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_required_groups(
             current_state, upgrades_available, unit, policy
         )
+        # Budget-saturation gauge inputs (metrics.py): how much of the
+        # effective maxUnavailable cap the fleet holds after admission.
+        astats = self.admission_stats
+        if ledger is not None:
+            astats["last_budget_used"] = ledger.unavailable_used()
+            astats["last_budget_cap"] = ledger.max_unavailable
+        else:
+            astats["last_budget_used"] = min(
+                max_unavailable,
+                in_progress_units + astats.get("last_admitted_units", 0),
+            )
+            astats["last_budget_cap"] = max_unavailable
         # Elastic negotiation sits between admission and cordon: absorbed
         # resizes (and decline/timeout fallbacks) re-bucket into
         # cordon-required and proceed in this same pass.
@@ -960,8 +991,49 @@ class ClusterUpgradeStateManager:
         # oldest-generation-first — the cheapest canary sees a new driver
         # before the flagship pools do.  Deterministic and label-derived,
         # so every controller incarnation computes the same order.
+        #
+        # Plan-guided packing (planning.admissionMode: packed): when the
+        # drift watchdog holds a FRESH plan, reorder WITHIN each
+        # generation class — the current planned wave's groups first,
+        # then first-fit-decreasing by cost so smaller groups fill the
+        # budget a denied head group would otherwise strand.  Every
+        # admission gate below (skip, incomplete slice, DCN, window
+        # holds upstream, fleet ∧ pool budgets) is unchanged, so packing
+        # can only reorder candidates, never over-admit; with no fresh
+        # plan the order degrades to exactly the greedy one.
+        plan = None
+        planning_spec = getattr(policy, "planning", None)
+        if (
+            planning_spec is not None
+            and getattr(planning_spec, "admission_mode", "greedy") == "packed"
+            and self.drift_watchdog is not None
+        ):
+            plan = self.drift_watchdog.fresh_plan()
+        packed = plan is not None
+        self.admission_mode = "packed" if packed else "greedy"
+        if packed:
+            unplanned_wave = 1 << 30
+
+            def _admission_key(group) -> tuple:
+                cost_ = 1 if unit == "slice" else group.size()
+                key = packed_group_sort_key(group, cost_)
+                wave = plan.wave_of(group.id)
+                # generation rank | planned wave | -cost | group id
+                return key[:3] + (
+                    wave if wave is not None else unplanned_wave,
+                ) + key[3:]
+
+        else:
+            _admission_key = group_sort_key
+        stats = self.admission_stats
+        stats["last_admitted_units"] = 0
+        # Budget-gate denials this pass, re-probed after the loop: any
+        # group the pass refused but could still afford is an idle-budget
+        # tick (structurally 0 — the loop fills residual budget — so the
+        # counter is a regression canary, not a steady-state signal).
+        budget_denied: list = []
         for group in sorted(
-            state.groups_in(UpgradeState.UPGRADE_REQUIRED), key=group_sort_key
+            state.groups_in(UpgradeState.UPGRADE_REQUIRED), key=_admission_key
         ):
             requested = [
                 m.node
@@ -1030,6 +1102,7 @@ class ClusterUpgradeStateManager:
                         "upgrade limit reached (ledger), pausing group %s",
                         group.id,
                     )
+                    budget_denied.append((group.id, cost, dcn))
                     continue
                 if already_cordoned:
                     logger.info(
@@ -1046,6 +1119,7 @@ class ClusterUpgradeStateManager:
                     logger.info(
                         "upgrade limit reached, pausing group %s", group.id
                     )
+                    budget_denied.append((group.id, cost, None))
                     continue
             else:
                 upgrades_available -= cost
@@ -1068,6 +1142,9 @@ class ClusterUpgradeStateManager:
                 ):
                     target = UpgradeState.NEGOTIATE_REQUIRED
             self.provider.change_nodes_upgrade_state(group.nodes, target)
+            stats["last_admitted_units"] += cost
+            if packed:
+                stats["packed_admitted"] = stats.get("packed_admitted", 0) + 1
             if (
                 group.slice_info is not None
                 and group.slice_info.dcn_group is not None
@@ -1078,6 +1155,24 @@ class ClusterUpgradeStateManager:
                 logger.info("group %s negotiating elastic resize", group.id)
             else:
                 logger.info("group %s waiting for cordon", group.id)
+
+        # Idle-budget canary: re-probe every budget-gate denial against
+        # the post-pass charge table.  Usage only grows within a pass,
+        # so a denial that is affordable NOW was affordable when tried —
+        # any hit means admission left chargeable pending work on the
+        # table (e.g. an early-return regression in this loop).
+        idle = False
+        ledger = self.budget_ledger
+        for gid, cost, dcn in budget_denied:
+            if ledger is not None:
+                if ledger.can_claim(gid, cost, dcn_group=dcn):
+                    idle = True
+                    break
+            elif cost <= upgrades_available:
+                idle = True
+                break
+        if idle:
+            stats["budget_idle_ticks"] = stats.get("budget_idle_ticks", 0) + 1
 
     def process_cordon_required_groups(self, state: ClusterUpgradeState) -> None:
         """Cordon all hosts, then advance (upgrade_state.go:635-654)."""

@@ -66,6 +66,9 @@ def _bench_shaped_summary() -> dict:
         "fused_battery_warm_s": 0.123,
         "fused_battery_cache_hit": True,
         "fused_battery_fallbacks": 0,
+        "packed_vs_greedy_waves": [123, 123],
+        "packed_engine_agrees": True,
+        "packed_idle_ticks": 12,
         "elastic_complete": True,
         "elastic_downtime_s": 12.345,
         "elastic_max_gap_s": 12.345,

@@ -126,6 +126,23 @@ PLAN_WALL_CEILING_S = 1.0
 PLANNER_TWIN_N_SLICES = 12
 PLANNER_TWIN_HOSTS = 4
 
+# Packed-admission stage: the plan-guided FFD pins.  A mixed-SIZE
+# 256-node fleet under a node-unit budget that no slice size divides
+# (5): greedy id-order admission strands budget whenever a 4-host slice
+# follows the 1-host slices (4 > residual 1), while packed
+# (first-fit-decreasing off the anchored plan) pairs a quad with a
+# single every wave.  Packed must beat greedy STRICTLY on both the
+# analytic wave count and the live-engine roll, the engine's packed
+# admission schedule must agree with the analytic packed plan exactly,
+# and neither mode may ever leave affordable pending work on the table
+# (budget_idle_ticks == 0).
+PACKED_N_SINGLES = 56
+PACKED_N_QUADS = 50  # 56*1 + 50*4 = 256 nodes
+PACKED_BUDGET_NODES = 5
+PACKED_PARALLEL = 8
+PACKED_TWIN_SINGLES = 4
+PACKED_TWIN_QUADS = 4
+
 
 def measure(
     slices: int = N_SLICES,
@@ -1013,6 +1030,147 @@ def measure_planner(
     }
 
 
+def measure_packed_admission(
+    n_singles: int = PACKED_N_SINGLES,
+    n_quads: int = PACKED_N_QUADS,
+    twin_singles: int = PACKED_TWIN_SINGLES,
+    twin_quads: int = PACKED_TWIN_QUADS,
+) -> dict:
+    """Plan-guided admission packing measurement; returns the artifact
+    dict (also embedded in BENCH_DETAILS.json by bench.py).
+
+    Two fleets, one shape: 1-host slices named to sort BEFORE 4-host
+    slices under the greedy id order, rolled under a node-unit budget
+    of 5.  Greedy admits singles first and strands 1-4 budget units
+    whenever a quad heads the residual; packed (FFD off the anchored
+    plan) pairs {4,1} every wave.  Stage 1 compares analytic plans at
+    256 nodes; stage 2 rolls the small fleet through the REAL engine
+    (digital twin) in both modes and cross-checks the packed engine's
+    admission schedule against the analytic packed plan."""
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        PlanningSpec,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.planning import plan_roll, run_twin
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    def _writes(cluster) -> int:
+        return int(
+            sum(
+                v
+                for k, v in cluster.stats.items()
+                if str(k)
+                .lower()
+                .startswith(
+                    ("patch", "create", "delete", "evict", "update", "post", "put")
+                )
+            )
+        )
+
+    def _sized_fleet(singles, quads):
+        keys = UpgradeKeys()
+        cluster = FakeCluster()
+        fx = ClusterFixture(cluster, keys)
+        ds = fx.daemon_set(hash_suffix="v1", revision=1)
+        for i in range(singles):
+            # "a-" < "b-": greedy id order tries every single first.
+            for n in fx.tpu_slice(
+                f"a-solo-{i:03d}", hosts=1, state=UpgradeState.DONE
+            ):
+                fx.driver_pod(n, ds, hash_suffix="v1")
+        for i in range(quads):
+            for n in fx.tpu_slice(
+                f"b-quad-{i:03d}", hosts=4, state=UpgradeState.DONE
+            ):
+                fx.driver_pod(n, ds, hash_suffix="v1")
+        fx.bump_daemon_set_template(ds, "v2", revision=2)
+        fx.auto_recreate_driver_pods(ds, "v2")
+        return keys, cluster
+
+    def _policy(mode):
+        return TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=PACKED_PARALLEL,
+            max_unavailable=IntOrString(PACKED_BUDGET_NODES),
+            unavailability_unit="node",
+            drain_spec=DrainSpec(enable=False),
+            planning=PlanningSpec(admission_mode=mode),
+        )
+
+    # -- 1. analytic greedy vs packed at 256 nodes ---------------------
+    keys, cluster = _sized_fleet(n_singles, n_quads)
+    manager = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    state = manager.build_state(NAMESPACE, DRIVER_LABELS, _policy("greedy"))
+    writes_before = _writes(cluster)
+    greedy_plan = plan_roll(manager, state, _policy("greedy"))
+    packed_plan = plan_roll(manager, state, _policy("packed"))
+    plan_writes = _writes(cluster) - writes_before
+
+    # -- 2. live engine (digital twin) greedy vs packed ----------------
+    tg_keys, tg_cluster = _sized_fleet(twin_singles, twin_quads)
+    twin_greedy = run_twin(
+        tg_cluster, NAMESPACE, DRIVER_LABELS, _policy("greedy"), keys=tg_keys
+    )
+    tp_keys, tp_cluster = _sized_fleet(twin_singles, twin_quads)
+    twin_packed = run_twin(
+        tp_cluster, NAMESPACE, DRIVER_LABELS, _policy("packed"), keys=tp_keys
+    )
+    # The analytic packed plan for the same small fleet — the engine's
+    # actual admission schedule must reproduce it wave for wave.
+    sp_keys, sp_cluster = _sized_fleet(twin_singles, twin_quads)
+    sp_manager = ClusterUpgradeStateManager(
+        sp_cluster, keys=sp_keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    sp_state = sp_manager.build_state(
+        NAMESPACE, DRIVER_LABELS, _policy("packed")
+    )
+    small_plan = plan_roll(sp_manager, sp_state, _policy("packed"))
+    planned_waves = [sorted(w.group_ids) for w in small_plan.waves]
+    engine_waves = [sorted(w) for w in twin_packed.waves]
+
+    return {
+        "stage": "packed_admission",
+        "nodes": n_singles + 4 * n_quads,
+        "budget_nodes": PACKED_BUDGET_NODES,
+        "greedy_waves": greedy_plan.wave_count,
+        "packed_waves": packed_plan.wave_count,
+        "greedy_duration_s": round(greedy_plan.projected_duration_s, 1),
+        "packed_duration_s": round(packed_plan.projected_duration_s, 1),
+        "plan_writes": plan_writes,
+        "twin_nodes": twin_singles + 4 * twin_quads,
+        "engine_greedy_converged": twin_greedy.converged,
+        "engine_packed_converged": twin_packed.converged,
+        "engine_greedy_waves": twin_greedy.wave_count,
+        "engine_packed_waves": twin_packed.wave_count,
+        "engine_greedy_duration_s": round(
+            twin_greedy.virtual_duration_s, 1
+        ),
+        "engine_packed_duration_s": round(
+            twin_packed.virtual_duration_s, 1
+        ),
+        "engine_packed_mode": twin_packed.admission_mode,
+        "engine_plan_wave_agrees": engine_waves == planned_waves,
+        "packed_admitted": twin_packed.admission.get("packed_admitted", 0),
+        "greedy_idle_ticks": twin_greedy.admission.get(
+            "budget_idle_ticks", 0
+        ),
+        "packed_idle_ticks": twin_packed.admission.get(
+            "budget_idle_ticks", 0
+        ),
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -1257,6 +1415,64 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (planner): {f}", file=sys.stderr)
+        return 1
+
+    packed = measure_packed_admission()
+    failures = []
+    if packed["packed_waves"] >= packed["greedy_waves"]:
+        failures.append(
+            f"packed plan took {packed['packed_waves']} wave(s) vs "
+            f"greedy {packed['greedy_waves']} at {packed['nodes']} "
+            "nodes (must be STRICTLY fewer — FFD stopped packing "
+            "residual budget)"
+        )
+    if packed["packed_duration_s"] >= packed["greedy_duration_s"]:
+        failures.append(
+            f"packed plan projects {packed['packed_duration_s']}s vs "
+            f"greedy {packed['greedy_duration_s']}s (must be strictly "
+            "faster)"
+        )
+    if packed["plan_writes"] != 0:
+        failures.append(
+            f"planning issued {packed['plan_writes']} API write "
+            "verb(s) (must be exactly 0 — planning is read-only)"
+        )
+    if not packed["engine_greedy_converged"]:
+        failures.append("greedy engine roll did not converge")
+    if not packed["engine_packed_converged"]:
+        failures.append("packed engine roll did not converge")
+    if packed["engine_packed_waves"] >= packed["engine_greedy_waves"]:
+        failures.append(
+            f"live engine rolled {packed['engine_packed_waves']} "
+            f"packed wave(s) vs {packed['engine_greedy_waves']} greedy "
+            "(must be strictly fewer — the engine is not following "
+            "the plan)"
+        )
+    if packed["engine_packed_mode"] != "packed":
+        failures.append(
+            "engine admission never used the packed ordering (no "
+            "fresh plan reached process_upgrade_required_groups)"
+        )
+    if not packed["engine_plan_wave_agrees"]:
+        failures.append(
+            "packed engine admission schedule diverged from the "
+            "analytic packed plan's waves"
+        )
+    if packed["greedy_idle_ticks"] != 0 or packed["packed_idle_ticks"] != 0:
+        failures.append(
+            f"budget idle ticks with admissible pending work: greedy "
+            f"{packed['greedy_idle_ticks']}, packed "
+            f"{packed['packed_idle_ticks']} (must be exactly 0 — "
+            "admission left affordable work on the table)"
+        )
+    packed["ok"] = not failures
+    print(json.dumps(packed, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(
+                f"bench-guard FAIL (packed admission): {f}",
+                file=sys.stderr,
+            )
         return 1
     return 0
 

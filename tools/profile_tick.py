@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Profile one 256-node active-roll reconcile tick.
+
+`make profile` — cProfile over a single build_state + apply_state pass
+against a FakeCluster mid-roll (every slice pending upgrade), printing
+the top 25 functions by cumulative time.  The first stop when
+bench-guard's tick-cost pins regress: the hot path is the same one the
+controller runs, minus the network.
+
+Zero external dependencies; everything comes from the repo's own test
+fixtures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import os
+import pstats
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+N_SLICES = 64
+HOSTS_PER_SLICE = 4  # 64 x 4 = 256 nodes
+TOP_N = 25
+
+
+def build_roll():
+    """A 256-node mixed-generation fleet one template bump past DONE."""
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    generations = [
+        "tpu-v4-podslice",
+        "tpu-v4-podslice",
+        "tpu-v5-lite-podslice",
+        "tpu-v6e-slice",
+    ]
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    for i in range(N_SLICES):
+        nodes = fx.tpu_slice(
+            f"pool-{i:03d}",
+            hosts=HOSTS_PER_SLICE,
+            state=UpgradeState.DONE,
+            accelerator=generations[i % len(generations)],
+        )
+        for n in nodes:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=8,
+        max_unavailable=IntOrString(8),
+        drain_spec=DrainSpec(enable=False),
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    return manager, policy, NAMESPACE, DRIVER_LABELS
+
+
+def tick(manager, policy, namespace, labels) -> None:
+    """One full controller-shaped pass: snapshot, act, settle."""
+    state = manager.build_state(namespace, labels, policy)
+    manager.apply_state(state, policy)
+    manager.wait_for_async_work()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--sort",
+        default="cumulative",
+        choices=["cumulative", "tottime", "calls"],
+        help="pstats sort key (default: cumulative)",
+    )
+    parser.add_argument(
+        "--top", type=int, default=TOP_N, help="rows to print"
+    )
+    args = parser.parse_args(argv)
+
+    manager, policy, namespace, labels = build_roll()
+    # Warm pass outside the profile: first-touch costs (imports, fixture
+    # lazy init) would otherwise drown the steady-state tick.
+    tick(manager, policy, namespace, labels)
+
+    prof = cProfile.Profile()
+    prof.enable()
+    tick(manager, policy, namespace, labels)
+    prof.disable()
+
+    print(
+        f"profile: one {N_SLICES * HOSTS_PER_SLICE}-node active-roll "
+        f"tick (top {args.top} by {args.sort})"
+    )
+    stats = pstats.Stats(prof, stream=sys.stdout)
+    stats.strip_dirs().sort_stats(args.sort).print_stats(args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
