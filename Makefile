@@ -13,7 +13,7 @@ BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
 .PHONY: all test test-fast chaos lint typecheck cov-report bench \
 	bench-guard graft-check clean generate generate-check docker-build \
-	docker-push .build-image plan whatif profile
+	docker-push .build-image plan whatif profile trace
 
 all: lint test
 
@@ -118,6 +118,12 @@ whatif:
 # cumulative time) — the first stop when bench-guard regresses.
 profile:
 	$(PYTHON) tools/profile_tick.py
+
+# Drive a fake-tier roll with tracing on and print the completed causal
+# span tree plus its critical-path makespan attribution (see
+# docs/observability.md).
+trace:
+	$(PYTHON) tools/trace_roll.py
 
 graft-check:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \

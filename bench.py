@@ -1355,6 +1355,7 @@ def main() -> None:
         measure_packed_admission,
         measure_planner,
         measure_sharded as measure_sharded_reconcile,
+        measure_tracing,
         measure_write_hygiene,
     )
 
@@ -1424,6 +1425,17 @@ def main() -> None:
     beat()
     log(f"packed admission (greedy vs FFD): {packed_admission}")
 
+    # -- roll tracing & flight recorder (gated by `make bench-guard`) --------
+    # Observe-only pins: the same active roll with the recorder on vs
+    # off stays under the 5% p99 tick-overhead ceiling, the completed
+    # trace is one connected tree whose critical-path buckets sum to the
+    # makespan, a 4096-node idle sharded fleet still walks 0 pools at 0
+    # writes with tracing on, and a black-box trigger storm stays under
+    # the spool byte cap.
+    tracing = measure_tracing()
+    beat()
+    log(f"tracing (overhead + attribution + black box): {tracing}")
+
     complete = seq_result["complete"]
     details = {
         "complete": complete,
@@ -1481,6 +1493,7 @@ def main() -> None:
         "write_hygiene": write_hygiene,
         "planner": planner,
         "packed_admission": packed_admission,
+        "tracing": tracing,
         "attribution_check": attribution,
         "probe_battery_warm_s": round(probe_warm_s, 3),
         "probe_battery_hot_s": round(probe_hot_s, 3),
@@ -1576,6 +1589,10 @@ def main() -> None:
         ],
         "packed_engine_agrees": packed_admission["engine_plan_wave_agrees"],
         "packed_idle_ticks": packed_admission["packed_idle_ticks"],
+        "tracing_overhead_pct": tracing["overhead_pct"],
+        "tracing_bucket_sum_error_pct": tracing["bucket_sum_error_pct"],
+        "tracing_idle_writes": tracing["idle_writes_total"],
+        "tracing_spool_bytes": tracing["spool_bytes"],
         "elastic_downtime_s": elastic_roll["downtime_s"],
         "elastic_max_gap_s": elastic_roll["max_gap_s"],
         "elastic_complete": elastic_roll["converged"],

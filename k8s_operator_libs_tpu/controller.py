@@ -132,6 +132,10 @@ class ControllerConfig:
     lease_namespace: Optional[str] = None
     # Candidate identity; auto hostname_uuid when empty.
     identity: str = ""
+    # Flight-recorder spool directory for black-box snapshots (obs/
+    # flightrec.py).  None = <tmpdir>/tpu-upgrade-blackbox; "" disables
+    # the on-disk spool (ring + triggers still run in memory).
+    trace_spool_dir: Optional[str] = None
 
 
 class UpgradeController:
@@ -244,9 +248,34 @@ class UpgradeController:
         # so projections tighten as the roll progresses.
         self.clock_tracker = PhaseClockTracker()
         self.watchdog.clock_tracker = self.clock_tracker
-        self.manager.provider.transition_observer = (
+        # Multicast registration: the trace recorder subscribed itself in
+        # the manager's constructor, and the clock tracker joins it here
+        # — each observer is exception-isolated by the provider.
+        self.manager.provider.add_transition_observer(
             self.clock_tracker.observe_group_transition
         )
+        # Black box: ring of recent facts + throttled redacted snapshots
+        # on failure triggers (stuck, infeasible, quarantine, circuit-
+        # open, crash-adoption).  Spool defaults under the system tmpdir;
+        # trace_spool_dir="" keeps it memory-only.
+        from k8s_operator_libs_tpu.obs.flightrec import FlightRecorder
+
+        spool_dir = config.trace_spool_dir
+        if spool_dir is None:
+            import os
+            import tempfile
+
+            spool_dir = os.path.join(
+                tempfile.gettempdir(), "tpu-upgrade-blackbox"
+            )
+        self.flight_recorder = FlightRecorder(spool_dir=spool_dir or None)
+        self.manager.set_flight_recorder(self.flight_recorder)
+        self.flight_recorder.snapshot_providers["informer"] = (
+            self._informer_snapshot
+        )
+        # One makespan breakdown publication per completed roll trace.
+        self._published_breakdown_trace: Optional[str] = None
+        self._last_breakdown: Optional[dict] = None
         # Plan-guided admission (planning.admissionMode: packed): the
         # engine's admission pass consults the watchdog's fresh plan to
         # ORDER chargeable groups — no budget/window/DCN gate is relaxed.
@@ -364,7 +393,12 @@ class UpgradeController:
                     else (self.config.identity or "standalone")
                 )
                 term = self.elector.term if self.elector is not None else 0
-                self.manager.adopt(state, identity=identity, term=term)
+                self.manager.adopt(
+                    state,
+                    identity=identity,
+                    term=term,
+                    policy=self.config.policy,
+                )
                 # Measured phase clocks ride the CR status: re-seed the
                 # EWMA on adoption so a restart or leader handoff does
                 # not reset estimates to the static defaults.  Loaded
@@ -403,18 +437,22 @@ class UpgradeController:
                 # Refresh node→pool attribution for the phase-clock
                 # tracker (full pass = whole-fleet scope), so measured
                 # durations are charged to the right pool's EWMA.
-                self.clock_tracker.seed_pools(
-                    {
-                        m.node.name: (
-                            self.manager._pool_for_group(
-                                g, self.config.policy
-                            )
-                            or ""
+                node_pools = {
+                    m.node.name: (
+                        self.manager._pool_for_group(
+                            g, self.config.policy
                         )
-                        for g in state.all_groups()
-                        for m in g.members
-                    }
-                )
+                        or ""
+                    )
+                    for g in state.all_groups()
+                    for m in g.members
+                }
+                self.clock_tracker.seed_pools(node_pools)
+                # Same attribution feeds the span tree: group spans hang
+                # under the right pool span.
+                rec = getattr(self.manager, "trace_recorder", None)
+                if rec is not None:
+                    rec.seed_pools(node_pools)
                 drift_report = self.watchdog.observe(
                     self.manager, state, self.config.policy
                 )
@@ -429,6 +467,7 @@ class UpgradeController:
             self._handle_circuit_open(e)
             return False
         self.metrics.observe_plan(drift_report)
+        self.metrics.observe_trace(self.manager, self._trace_breakdown())
         if self.config.policy_ref is not None:
             self._update_cr_status(state)
         duration = time.monotonic() - t0
@@ -593,6 +632,60 @@ class UpgradeController:
         deposed leader's queued writes drop at flush."""
         return getattr(self.manager, "write_plan", None)
 
+    def _informer_snapshot(self):
+        """Informer cache health for black-box snapshots (None when the
+        controller runs without a watch)."""
+        informer = self.informer
+        if informer is None:
+            return None
+        age = informer.age_s()
+        return {
+            "age_seconds": age if age != float("inf") else None,
+            "stats": dict(getattr(informer, "stats", {}) or {}),
+        }
+
+    def _trace_breakdown(self) -> Optional[dict]:
+        """Critical-path makespan attribution for the most recently
+        COMPLETED roll trace, computed once per trace id (the analysis
+        walks the whole span tree) and cached for the CR status, the
+        metrics surface and the status CLI."""
+        rec = getattr(self.manager, "trace_recorder", None)
+        if rec is None:
+            return self._last_breakdown
+        completed = rec.last_completed()
+        if completed is None:
+            return self._last_breakdown
+        if completed.trace_id == self._published_breakdown_trace:
+            return self._last_breakdown
+        from k8s_operator_libs_tpu.obs.critical import (
+            analyze,
+            expected_from_tracker,
+            makespan_breakdown,
+            phase_drift,
+        )
+
+        try:
+            attribution = analyze(completed)
+            expected = expected_from_tracker(self.clock_tracker)
+            drift = phase_drift(attribution, expected)
+            breakdown = makespan_breakdown(attribution, drift)
+        except Exception:  # noqa: BLE001 — attribution is advisory
+            logger.exception(
+                "makespan attribution failed for trace %s",
+                completed.trace_id,
+            )
+            self._published_breakdown_trace = completed.trace_id
+            return self._last_breakdown
+        self._published_breakdown_trace = completed.trace_id
+        self._last_breakdown = breakdown
+        logger.info(
+            "roll %s complete: makespan %.1fs across %d group(s)",
+            completed.trace_id,
+            breakdown.get("makespanSeconds", 0.0),
+            breakdown.get("groups", 0),
+        )
+        return breakdown
+
     def _handle_circuit_open(self, exc: CircuitOpenError) -> None:
         """Degrade gracefully instead of crashing or wedging: log once
         per pass, publish the gauge, and best-effort surface a Degraded
@@ -607,6 +700,11 @@ class UpgradeController:
         self.metrics.registry.set(
             "api_circuit_open_endpoints",
             float(max(1, self._open_circuit_count())),
+        )
+        self.flight_recorder.trigger(
+            "circuit_open",
+            detail=str(exc),
+            open_endpoints=self._open_circuit_count(),
         )
         self._flush_events()
         if self.config.policy_ref is None or self._policy_cr is None:
@@ -826,6 +924,19 @@ class UpgradeController:
                 status["planReplans"] = report.replans
                 if report.infeasible:
                     status["planInfeasible"] = list(report.infeasible)
+            # Roll-tracing surface: the active trace id joins the plan
+            # block (Events carry the same id, so operators can pivot
+            # Events ↔ trace ↔ plan), and a completed roll publishes its
+            # critical-path makespan attribution.
+            rec = getattr(m, "trace_recorder", None)
+            active_trace = (
+                rec.active_trace_id() if rec is not None else None
+            )
+            if active_trace:
+                status["planTraceId"] = active_trace
+            breakdown = self._trace_breakdown()
+            if breakdown:
+                status["makespanBreakdown"] = breakdown
             # Measured per-pool phase clocks (EWMA): durable through the
             # write plane so a successor controller adopts them instead
             # of restarting from the static defaults.

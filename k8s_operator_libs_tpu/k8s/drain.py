@@ -158,6 +158,7 @@ class DrainHelper:
         escalation_stats: Optional[EscalationStats] = None,
         fence: Optional[Callable[[], bool]] = None,
         rung_store=None,
+        trace_hook: Optional[Callable[[str, str], None]] = None,
     ) -> None:
         self.client = client
         self.force = force
@@ -190,6 +191,18 @@ class DrainHelper:
         # controller resumes each node's ladder at its persisted rung with
         # the original entry time, not back at rung 0.
         self.rung_store = rung_store
+        # Observe-only rung tap: called as trace_hook(node_name, rung) on
+        # every rung entry (initial, resumed, escalated).  Failures are
+        # swallowed — tracing must never stall an eviction.
+        self.trace_hook = trace_hook
+
+    def _trace_rung(self, node_name: str, rung: str) -> None:
+        if self.trace_hook is None or not node_name:
+            return
+        try:
+            self.trace_hook(node_name, rung)
+        except Exception:
+            pass  # observe-only
 
     # -- cordon ------------------------------------------------------------
 
@@ -300,6 +313,8 @@ class DrainHelper:
                     continue
             rung[key] = RUNG_EVICT
             rung_since[key] = now
+        for key in by_key:
+            self._trace_rung(node_of[key], rung[key])
         if self.escalation_stats is not None:
             for key in by_key:
                 if key not in resumed:
@@ -353,6 +368,7 @@ class DrainHelper:
                         continue
                     rung_since[key] = now
                     issued.discard(key)
+                    self._trace_rung(node_of[key], rung[key])
                     if self.escalation_stats is not None:
                         self.escalation_stats.record(rung[key])
                     if store is not None and node_of[key]:

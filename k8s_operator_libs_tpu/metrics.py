@@ -832,6 +832,38 @@ class UpgradeMetrics:
         r.set("plan_infeasible", len(report.infeasible))
         r.set("plan_replans_total", report.replans)
 
+    def observe_trace(self, manager, breakdown=None) -> None:
+        """Publish the roll-tracing surface (obs/): recorder health
+        (open spans, fail-open drops), flight-recorder activity (dumps
+        per trigger reason, spool footprint), and — once a roll
+        completes — its critical-path makespan buckets.  Everything here
+        is getattr-guarded: injected fake managers without the obs
+        wiring publish nothing."""
+        r = self.registry
+        rec = getattr(manager, "trace_recorder", None)
+        if rec is not None:
+            r.set("trace_spans_open", rec.open_span_count())
+            r.set("trace_drops_total", rec.drops)
+            r.set("trace_active", 1.0 if rec.active else 0.0)
+        fr = getattr(manager, "flight_recorder", None)
+        if fr is not None:
+            for reason, count in sorted(fr.dumps_total.items()):
+                r.set("flightrec_dumps_total", count, reason=reason)
+            r.set("flightrec_throttled_total", fr.throttled_total)
+            r.set("flightrec_note_drops_total", fr.note_drops)
+            r.set("flightrec_spool_bytes", fr.spool_bytes())
+        if breakdown:
+            r.set(
+                "roll_makespan_seconds",
+                breakdown.get("makespanSeconds", 0.0),
+            )
+            for bucket, seconds in sorted(
+                (breakdown.get("buckets") or {}).items()
+            ):
+                r.set(
+                    "roll_makespan_bucket_seconds", seconds, bucket=bucket
+                )
+
     def observe_sharded(self, sharded, report=None) -> None:
         """Publish the sharded-reconcile surface.  Called with a
         TickReport after each dirty tick, and without one after a full

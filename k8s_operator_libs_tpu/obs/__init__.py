@@ -1,0 +1,44 @@
+"""Roll tracing & flight recorder (observability layer).
+
+Three read-mostly, fail-open parts:
+
+- :mod:`trace` — span model + recorder: every fleet roll becomes one
+  causal span tree (roll → pool → wave → slice-group → node → phase,
+  plus wait spans), recorded at the engine's existing choke points,
+  crash-durable via the node-annotation write plane, continued across
+  controller failover by ``manager.adopt()``.
+- :mod:`flightrec` — black box: a fixed-size ring of recent facts and
+  a throttled, byte-capped on-disk spool of redacted JSON snapshots
+  dumped when something goes wrong (stuck detector, infeasibility,
+  quarantine, circuit-open, crash-adoption).
+- :mod:`critical` — critical-path makespan attribution: on roll
+  completion, bucket the makespan into phase-time vs budget-wait vs
+  window-hold vs quarantine vs API-retry, compare per-phase actuals
+  against the PhaseClocks projection, and publish the top drift
+  contributors (CR ``makespanBreakdown``, metrics, ``make trace``).
+
+Tracing is observe-only by contract: every entry point fails open, so
+a recorder failure can never block a state transition (drops are
+counted into ``trace_drops_total`` instead).  See docs/observability.md.
+"""
+
+from k8s_operator_libs_tpu.obs.trace import (  # noqa: F401
+    CompletedTrace,
+    Span,
+    TraceRecorder,
+    format_anchor,
+    parse_anchor,
+)
+from k8s_operator_libs_tpu.obs.flightrec import (  # noqa: F401
+    FlightRecorder,
+    redact,
+)
+from k8s_operator_libs_tpu.obs.critical import (  # noqa: F401
+    Attribution,
+    analyze,
+    expected_from_tracker,
+    makespan_breakdown,
+    phase_drift,
+    render_breakdown,
+    render_tree,
+)

@@ -426,11 +426,18 @@ def gather(
                     "planInfeasible",
                     "admissionMode",
                     "budgetSaturation",
+                    "planTraceId",
                 )
                 if key in cr_status
             }
             if cr_plan:
                 policy_section["plan"] = cr_plan
+            # Completed-roll makespan attribution (obs/critical.py),
+            # durable on the CR so the CLI renders it after the fact.
+            if cr_status.get("makespanBreakdown"):
+                policy_section["makespanBreakdown"] = cr_status[
+                    "makespanBreakdown"
+                ]
             try:
                 policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
             except (ValueError, TypeError):
@@ -914,6 +921,19 @@ def render(status: dict) -> str:
                     "  invalid maintenance-window cron (failing open): "
                     + ", ".join(invalid)
                 )
+            trace_id = plan.get("planTraceId") or (
+                (status.get("policy") or {}).get("plan") or {}
+            ).get("planTraceId")
+            if trace_id:
+                lines.append(f"  trace: {trace_id}")
+    breakdown = (status.get("policy") or {}).get("makespanBreakdown")
+    if breakdown:
+        from k8s_operator_libs_tpu.obs.critical import render_breakdown
+
+        lines.append("")
+        lines.append("last roll (critical-path attribution):")
+        for row in render_breakdown(breakdown).splitlines():
+            lines.append(f"  {row}")
     api_health = status.get("apiHealth")
     if api_health is not None and api_health.get("openCircuits"):
         lines.append("")

@@ -252,6 +252,16 @@ UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT = (
     "{domain}/{driver}-driver-upgrade-quarantine-cycle-count"
 )
 
+# --- roll tracing (obs/) ----------------------------------------------------
+# Durable trace anchor: "<trace_id>|<state>|<epoch>", staged into the SAME
+# node intent as every state-label flip (zero extra writes) and read back
+# by manager.adopt() so a restarted controller continues the same span
+# tree — the AnnotationRungStore idiom applied to the roll trace.  Cleared
+# when the group reaches done/unknown.
+UPGRADE_TRACE_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-trace"
+)
+
 # --- elastic roll coordination ---------------------------------------------
 # The annotation-mediated negotiation protocol between the controller and
 # an elastic workload (coordination.WorkloadCoordinator).  The node

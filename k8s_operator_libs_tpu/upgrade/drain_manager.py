@@ -93,6 +93,9 @@ class DrainManager:
         self.fence = None
         self.term_fence = None
         self.rung_store = None
+        # Roll tracing (obs/trace.py): fanned in by the state
+        # manager; feeds eviction-rung entries into the span tree.
+        self.trace_recorder = None
         # Dedup of in-flight drains across reconcile passes
         # (drain_manager.go:103: drainingNodes StringSet), keyed by group id.
         self._draining = StringSet()
@@ -179,6 +182,11 @@ class DrainManager:
                 escalation_stats=self.escalation_stats,
                 fence=self.fence,
                 rung_store=self.rung_store,
+                trace_hook=(
+                    self.trace_recorder.rung_entered
+                    if self.trace_recorder is not None
+                    else None
+                ),
             )
             policy_failed: list[str] = []
             transient: list[str] = []

@@ -101,12 +101,82 @@ class NodeUpgradeStateProvider:
         self.plan = plan or WritePlan(
             client, max_concurrency=max_concurrency
         )
-        # Phase-clock telemetry (planning/clocks.py): called once per
-        # GROUP transition with (nodes, new_state) BEFORE the new labels
-        # are staged — change_nodes_upgrade_state is the one choke point
-        # every group-level transition goes through.  Read-only; a
-        # failing observer must never block a transition.
-        self.transition_observer = None
+        # Transition observers (phase clocks, trace recorder, ...):
+        # each is called once per GROUP transition with
+        # (nodes, new_state) BEFORE the new labels are staged —
+        # change_nodes_upgrade_state is the one choke point every
+        # group-level transition goes through.  Read-only; observers
+        # are exception-isolated from each other and a failing observer
+        # must never block a transition.
+        self._transition_observers: list = []
+        # Durable trace anchor (obs/trace.py): when set, returns an
+        # annotation patch merged into the SAME intent as the state
+        # label — crash durability that costs zero extra API writes.
+        self.transition_annotation_source = None
+
+    # -- transition observers ------------------------------------------------
+
+    @property
+    def transition_observer(self):
+        """Back-compat single-slot view: the first registered observer
+        (None when the list is empty).  Assigning REPLACES the whole
+        list — multi-observer users must go through
+        :meth:`add_transition_observer`."""
+        return (
+            self._transition_observers[0]
+            if self._transition_observers
+            else None
+        )
+
+    @transition_observer.setter
+    def transition_observer(self, fn) -> None:
+        self._transition_observers = [] if fn is None else [fn]
+
+    def add_transition_observer(self, fn) -> None:
+        """Register an additional group-transition observer."""
+        if fn is not None and fn not in self._transition_observers:
+            self._transition_observers.append(fn)
+
+    def remove_transition_observer(self, fn) -> None:
+        try:
+            self._transition_observers.remove(fn)
+        except ValueError:
+            pass
+
+    def _fire_transition_observers(self, nodes, new_state) -> None:
+        """Multicast with per-observer exception isolation: one raising
+        observer never starves the others, and none can block the
+        transition itself."""
+        for observer in list(self._transition_observers):
+            try:
+                observer(nodes, new_state)
+            except Exception:
+                logger.exception("transition observer failed; continuing")
+
+    def _trace_annotations(self, node, new_state) -> dict:
+        """Durable trace-anchor patch riding the state-label intent
+        (fail-open: tracing must never block or dirty a transition)."""
+        source = self.transition_annotation_source
+        if source is None:
+            return {}
+        try:
+            extra = source(node, new_state)
+        except Exception:
+            logger.exception("transition annotation source failed")
+            return {}
+        if not extra:
+            return {}
+        # Suppress no-op anchor writes against the cached object so an
+        # idempotent re-drive stays write-free.
+        out = {}
+        for key, value in extra.items():
+            current = node.metadata.annotations.get(key)
+            if value is None and key not in node.metadata.annotations:
+                continue
+            if value is not None and current == value:
+                continue
+            out[key] = value
+        return out
 
     # -- write coalescing ----------------------------------------------------
 
@@ -232,17 +302,33 @@ class NodeUpgradeStateProvider:
             # No-op against the cached object: suppress the round trip.
             self.plan.note_suppressed()
             return
+        trace_annotations = self._trace_annotations(node, new_state)
         if self.plan.in_scope():
             # Scoped: stage the intent and apply to the caller's object
             # immediately (read-your-writes within the pass); the API
             # write lands at scope exit.
-            self.plan.stage(node.name, labels={key: value}, node=node)
+            self.plan.stage(
+                node.name,
+                labels={key: value},
+                annotations=trace_annotations or None,
+                node=node,
+            )
             if value is None:
                 node.metadata.labels.pop(key, None)
             else:
                 node.metadata.labels[key] = value
+            for akey, avalue in trace_annotations.items():
+                if avalue is None:
+                    node.metadata.annotations.pop(akey, None)
+                else:
+                    node.metadata.annotations[akey] = avalue
             return
-        intent = self.plan.stage(node.name, labels={key: value}, node=node)
+        intent = self.plan.stage(
+            node.name,
+            labels={key: value},
+            annotations=trace_annotations or None,
+            node=node,
+        )
         with self._node_mutex.lock(node.name):
             try:
                 flushed = self.plan.flush_intent(intent)
@@ -310,11 +396,8 @@ class NodeUpgradeStateProvider:
         Raises on the first failure after all attempts complete, so a
         partially-written slice is re-driven by the next idempotent pass
         (the group's effective_state resolves to the earliest member)."""
-        if self.transition_observer is not None and nodes:
-            try:
-                self.transition_observer(nodes, new_state)
-            except Exception:
-                logger.exception("transition observer failed; continuing")
+        if nodes:
+            self._fire_transition_observers(nodes, new_state)
         if self.plan.in_scope():
             # Inside a coalescing scope: fanning out to worker threads
             # would leave this thread's scope behind, so stage in-line

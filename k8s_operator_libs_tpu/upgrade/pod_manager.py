@@ -108,6 +108,9 @@ class PodManager:
         self.fence = None
         self.term_fence = None
         self.rung_store = None
+        # Roll tracing (obs/trace.py): fanned in by the state
+        # manager; feeds eviction-rung entries into the span tree.
+        self.trace_recorder = None
         # Apiserver-facing poll cadence for eviction waits (kubectl-like
         # 1 s in production; tests pass the suite's fast interval).
         self.poll_interval_s = poll_interval_s
@@ -241,6 +244,11 @@ class PodManager:
                 escalation_stats=self.escalation_stats,
                 fence=self.fence,
                 rung_store=self.rung_store,
+                trace_hook=(
+                    self.trace_recorder.rung_entered
+                    if self.trace_recorder is not None
+                    else None
+                ),
             )
             total_to_delete = 0
             failed = False

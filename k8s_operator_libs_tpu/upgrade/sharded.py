@@ -324,6 +324,19 @@ class BudgetLedger:
         # group_id → pool name resolver supplied by the engine; lets
         # callers omit the ``pool=`` argument on try_claim.
         self.pool_resolver: Optional[Callable[[str], Optional[str]]] = None
+        # Observe-only verdict tap (flight recorder): called as
+        # ``trace_hook(verdict, group_id, **info)`` outside the lock,
+        # never allowed to fail a claim.
+        self.trace_hook: Optional[Callable[..., None]] = None
+
+    def _tap(self, verdict: str, group_id: str, **info) -> None:
+        hook = self.trace_hook
+        if hook is None:
+            return
+        try:
+            hook(verdict, group_id, **info)
+        except Exception:  # observe-only: never fail admission
+            logger.debug("budget trace hook failed", exc_info=True)
 
     def configure(
         self,
@@ -448,14 +461,26 @@ class BudgetLedger:
             if not force:
                 if self._denied_locked(group_id, cost, dcn_group, pool):
                     self._waiters.add(group_id)
-                    return False
-            self._charges[group_id] = cost
-            self._waiters.discard(group_id)
-            if dcn_group is not None:
-                self._dcn_of[group_id] = dcn_group
-            if pool is not None:
-                self._pool_of_charge[group_id] = pool
-            return True
+                    denied = True
+                else:
+                    denied = False
+            else:
+                denied = False
+            if not denied:
+                self._charges[group_id] = cost
+                self._waiters.discard(group_id)
+                if dcn_group is not None:
+                    self._dcn_of[group_id] = dcn_group
+                if pool is not None:
+                    self._pool_of_charge[group_id] = pool
+        self._tap(
+            "denied" if denied else "granted",
+            group_id,
+            cost=cost,
+            pool=pool,
+            forced=force,
+        )
+        return not denied
 
     def release(self, group_id: str) -> None:
         waiters: set[str] = set()
@@ -468,6 +493,8 @@ class BudgetLedger:
                 waiters, self._waiters = self._waiters, set()
         # Callback OUTSIDE the lock: it marks the dirty queue (its own
         # lock) and may wake the controller.
+        if had is not None:
+            self._tap("released", group_id, cost=had, woke=len(waiters))
         if waiters and self.on_release is not None:
             self.on_release(waiters)
 

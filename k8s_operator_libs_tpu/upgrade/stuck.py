@@ -103,6 +103,33 @@ class StuckStateDetector:
         self.fleet_infeasibility: list[str] = []
         self._fleet_last_emit: dict[str, float] = {}
         self._fleet_published: set[str] = set()
+        # Observability taps (obs/): a black box to trigger on stuck /
+        # infeasible, and a ``() -> " (trace=<id>)" | ""`` source so the
+        # Warning events carry the active roll-trace id.  Both optional
+        # and fail-open — the detector stays read-only either way.
+        self.flight_recorder = None
+        self.trace_suffix_source: Optional[Callable[[], str]] = None
+
+    def _trace_suffix(self) -> str:
+        source = self.trace_suffix_source
+        if source is None:
+            return ""
+        try:
+            return source() or ""
+        except Exception:
+            return ""
+
+    def _blackbox(self, trigger_reason: str, **context) -> None:
+        # Parameter deliberately NOT named "reason": context carries a
+        # ``detail=<progress-blocker reason>`` and a same-named keyword
+        # would collide at the call site — outside any fail-open guard.
+        recorder = self.flight_recorder
+        if recorder is None:
+            return
+        try:
+            recorder.trigger(trigger_reason, **context)
+        except Exception:
+            logger.debug("flight-recorder trigger failed", exc_info=True)
 
     def add_reason_source(
         self, source: Callable[[str], Optional[str]]
@@ -246,7 +273,9 @@ class StuckStateDetector:
             ):
                 continue
             self._fleet_last_emit[slug] = now_mono
-            message = f"Roll is plan-infeasible: {reason}"
+            message = (
+                f"Roll is plan-infeasible: {reason}{self._trace_suffix()}"
+            )
             logger.warning("%s", message)
             if anchor is not None:
                 log_event(
@@ -256,6 +285,7 @@ class StuckStateDetector:
                     "RollInfeasible",
                     message,
                 )
+            self._blackbox("infeasible", slug=slug, detail=reason)
         return reasons
 
     def _drop_series(self, group_id: str) -> None:
@@ -281,7 +311,7 @@ class StuckStateDetector:
         message = (
             f"Upgrade stuck: group {group.id} has been in "
             f"'{state_value}' for {dwell:.0f}s (threshold "
-            f"{self.threshold_s:.0f}s): {reason}"
+            f"{self.threshold_s:.0f}s): {reason}{self._trace_suffix()}"
         )
         logger.warning("%s", message)
         for node in group.nodes:
@@ -292,3 +322,10 @@ class StuckStateDetector:
                 self.keys.event_reason,
                 message,
             )
+        self._blackbox(
+            "stuck",
+            group=group.id,
+            state=state_value,
+            stuck_seconds=round(dwell, 1),
+            detail=reason,
+        )

@@ -131,6 +131,8 @@ class ClusterUpgradeStateManager:
         poll_interval_s: float = 1.0,
         poll_timeout_s: float = 10.0,
         drain_poll_interval_s: Optional[float] = None,
+        trace_recorder=None,
+        enable_tracing: bool = True,
     ) -> None:
         self.client = client
         self.keys = keys or UpgradeKeys()
@@ -325,6 +327,121 @@ class ClusterUpgradeStateManager:
         # "packed" — packed requires a fresh plan, so a stale anchor
         # reports greedy here even with admissionMode: packed).
         self.admission_mode = "greedy"
+        # Roll tracing (obs/trace.py): every roll becomes a causal span
+        # tree recorded at the engine's existing choke points.  Observe
+        # -only and fail-open by contract — the recorder can never block
+        # a transition; pass enable_tracing=False (bench OFF leg) to
+        # remove even the hook overhead.
+        self.trace_recorder = None
+        if enable_tracing:
+            # Deferred import: obs.trace needs upgrade.consts, so a
+            # module-level import here would close an import cycle when
+            # the obs package is imported first.
+            from k8s_operator_libs_tpu.obs.trace import TraceRecorder
+
+            self.trace_recorder = trace_recorder or TraceRecorder()
+        if self.trace_recorder is not None:
+            rec = self.trace_recorder
+            # Durable anchor annotation rides the state-label intents.
+            rec.annotation_key = self.keys.trace_annotation
+            add_observer = getattr(
+                self.provider, "add_transition_observer", None
+            )
+            if add_observer is not None:  # injected fakes may lack it
+                add_observer(rec.observe_group_transition)
+            try:
+                self.provider.transition_annotation_source = (
+                    rec.annotation_source
+                )
+            except AttributeError:
+                pass
+            # Eviction-rung + validation-gate hooks flow to the helper
+            # owners the same way as escalation_stats/rung_store.
+            for mgr in (
+                self.drain_manager,
+                self.pod_manager,
+                self.validation_manager,
+            ):
+                if getattr(mgr, "trace_recorder", None) is None:
+                    try:
+                        mgr.trace_recorder = rec
+                    except AttributeError:
+                        pass  # injected fakes may refuse the attribute
+            try:
+                # Stuck / RollInfeasible Warnings carry the trace id.
+                self.stuck_detector.trace_suffix_source = (
+                    self._trace_event_suffix
+                )
+            except AttributeError:
+                pass
+        # Flight recorder (obs/flightrec.py): wired by the controller
+        # via set_flight_recorder(); None means "no black box".
+        self.flight_recorder = None
+
+    # -- observability wiring (obs/) -----------------------------------------
+
+    def set_flight_recorder(self, recorder) -> None:
+        """Wire the black box (obs/flightrec.py) into every feed and
+        trigger point the manager owns: span-opening deltas, budget
+        verdicts, stuck/quarantine/adoption triggers, and the snapshot
+        providers (active span tree + ledger state)."""
+        self.flight_recorder = recorder
+        if recorder is None:
+            return
+        if self.trace_recorder is not None:
+            self.trace_recorder.flight_recorder = recorder
+            recorder.snapshot_providers["trace"] = self.trace_recorder.export
+        recorder.snapshot_providers["ledger"] = self._ledger_snapshot_dict
+        try:
+            self.stuck_detector.flight_recorder = recorder
+        except AttributeError:
+            pass  # injected fakes may refuse the attribute
+        ledger = self.budget_ledger
+        if ledger is not None:
+            try:
+                ledger.trace_hook = self._note_budget
+            except AttributeError:
+                pass
+
+    def _note_budget(self, verdict: str, group_id: str, **info) -> None:
+        """BudgetLedger trace hook → flight-recorder ring (fail-open)."""
+        recorder = self.flight_recorder
+        if recorder is not None:
+            recorder.note("budget", verdict=verdict, group=group_id, **info)
+
+    def _ledger_snapshot_dict(self):
+        """Ledger state for black-box snapshots (None when unsharded)."""
+        ledger = self.budget_ledger
+        if ledger is None:
+            return None
+        try:
+            snap = ledger.snapshot()
+            return {
+                k: (sorted(v) if isinstance(v, (set, frozenset)) else v)
+                for k, v in vars(snap).items()
+            }
+        except Exception as e:  # noqa: BLE001 — snapshots are advisory
+            return {"error": str(e)}
+
+    def _flightrec_trigger(self, trigger_reason: str, **context) -> None:
+        # Parameter deliberately NOT named "reason": context may carry a
+        # ``detail=<engine reason>`` keyword, and a same-named parameter
+        # would collide at the call site — outside any fail-open guard.
+        recorder = self.flight_recorder
+        if recorder is None:
+            return
+        try:
+            recorder.trigger(trigger_reason, **context)
+        except Exception:  # noqa: BLE001 — black box is fail-open
+            logger.debug("flight-recorder trigger failed", exc_info=True)
+
+    def _trace_event_suffix(self) -> str:
+        """``" (trace=<id>)"`` while a roll trace is active, else ``""``
+        — appended to correlated Warning events so operators can join
+        Events ↔ trace ↔ plan without guessing."""
+        rec = self.trace_recorder
+        trace_id = rec.active_trace_id() if rec is not None else None
+        return f" (trace={trace_id})" if trace_id else ""
 
     # -- option builders (upgrade_state.go:153-186) --------------------------
 
@@ -415,6 +532,7 @@ class ClusterUpgradeStateManager:
         state: ClusterUpgradeState,
         identity: str = "",
         term: int = -1,
+        policy=None,
     ) -> dict[str, int]:
         """Re-adoption pass: run ONCE when this process acquires the
         lease (or starts without HA), against a fresh snapshot.
@@ -434,7 +552,13 @@ class ClusterUpgradeStateManager:
         - every in-flight node stamped ``<identity>@<term>`` so actions
           of a deposed leader's term are distinguishable from this one's.
         """
-        summary = {"groups": 0, "rungs": 0, "rollbacks": 0, "probes": 0}
+        summary = {
+            "groups": 0,
+            "rungs": 0,
+            "rollbacks": 0,
+            "probes": 0,
+            "traces": 0,
+        }
         now_epoch = int(time.time())
 
         # (a) Seed the shared escalation counters from persisted rungs:
@@ -480,15 +604,49 @@ class ClusterUpgradeStateManager:
         # failed stamp degrades observability, never the adoption.
         stamp = format_adoption_stamp(identity or "unknown", term)
         adopt_key = self.keys.adopted_by_annotation
+        trace_key = self.keys.trace_annotation
+        recorder = self.trace_recorder
         for st in tuple(IN_PROGRESS_STATES) + (
             UpgradeState.FAILED,
             UpgradeState.QUARANTINED,
             # Serving hosts, but the rejoin-resize completion is still a
             # controller action that must be term-fenced.
             UpgradeState.REJOIN_RESIZE_REQUIRED,
+            # Queued groups hold an open budget-wait span in the trace.
+            UpgradeState.UPGRADE_REQUIRED,
         ):
             for group in state.groups_in(st):
-                summary["groups"] += 1
+                if st != UpgradeState.UPGRADE_REQUIRED:
+                    summary["groups"] += 1
+                # (e) Trace continuity: the persisted anchor re-opens the
+                # group's in-flight spans under the new identity@term, so
+                # the restarted controller CONTINUES the same trace id
+                # instead of minting a fresh one mid-roll.
+                if recorder is not None:
+                    anchors = [
+                        m.node.annotations.get(trace_key)
+                        for m in group.members
+                    ]
+                    anchor = next((a for a in anchors if a), None)
+                    if anchor is not None:
+                        pool = None
+                        if policy is not None:
+                            try:
+                                pool = self._pool_for_group(group, policy)
+                            except Exception:  # noqa: BLE001 — pool
+                                # attribution is advisory
+                                pool = None
+                        reopened = recorder.reopen_group(
+                            [m.node for m in group.members],
+                            anchor,
+                            pool=pool,
+                            adopted_by=stamp,
+                            now_epoch=now_epoch,
+                        )
+                        if reopened:
+                            summary["traces"] += 1
+                if st == UpgradeState.UPGRADE_REQUIRED:
+                    continue  # queued groups are not stamped/fenced
                 stale = [
                     m.node
                     for m in group.members
@@ -507,13 +665,24 @@ class ClusterUpgradeStateManager:
                         )
         logger.info(
             "re-adoption (%s): %d in-flight group(s), %d persisted "
-            "ladder rung(s), %d pending rollback(s), %d probe backoff(s)",
+            "ladder rung(s), %d pending rollback(s), %d probe "
+            "backoff(s), %d trace span(s) re-opened",
             stamp,
             summary["groups"],
             summary["rungs"],
             summary["rollbacks"],
             summary["probes"],
+            summary["traces"],
         )
+        if summary["groups"] or summary["traces"]:
+            # Crash-adoption is a black-box trigger: capture what the
+            # new leader inherited before it starts mutating.
+            self._flightrec_trigger(
+                "adoption",
+                identity=stamp,
+                groups=summary["groups"],
+                traces=summary["traces"],
+            )
         return summary
 
     # -- BuildState (upgrade_state.go:214-279) -------------------------------
@@ -845,8 +1014,19 @@ class ClusterUpgradeStateManager:
                 total_units,
             )
 
+        if ledger is not None and getattr(ledger, "trace_hook", None) is None:
+            # Budget verdicts feed the flight-recorder ring (fail-open;
+            # ephemeral ledgers are rebuilt per pass, so re-wire here).
+            try:
+                ledger.trace_hook = self._note_budget
+            except AttributeError:
+                pass
         self.process_done_or_unknown_groups(current_state, UpgradeState.UNKNOWN)
         self.process_done_or_unknown_groups(current_state, UpgradeState.DONE)
+        if self.trace_recorder is not None:
+            # Wave boundary: groups the coming admission pass charges
+            # share one wave span per pool in the roll trace.
+            self.trace_recorder.begin_admission_pass()
         self.process_upgrade_required_groups(
             current_state, upgrades_available, unit, policy
         )
@@ -908,6 +1088,12 @@ class ClusterUpgradeStateManager:
             self.stuck_detector.observe_fleet(
                 current_state, policy, manager=self
             )
+            if self.trace_recorder is not None:
+                # Roll completion is only decidable fleet-wide: when
+                # every traced group has reached a terminal state the
+                # recorder closes the trace and hands the completed span
+                # tree to obs/critical.py via last_completed().
+                self.trace_recorder.maybe_end_roll()
         logger.info("state manager finished processing")
 
     # -- processors ----------------------------------------------------------
@@ -1120,9 +1306,23 @@ class ClusterUpgradeStateManager:
                         "upgrade limit reached, pausing group %s", group.id
                     )
                     budget_denied.append((group.id, cost, None))
+                    # Unsharded path has no ledger tap: feed the black
+                    # box directly so denial history survives a crash.
+                    self._note_budget(
+                        "denied",
+                        group.id,
+                        cost=cost,
+                        available=upgrades_available,
+                    )
                     continue
             else:
                 upgrades_available -= cost
+                self._note_budget(
+                    "granted",
+                    group.id,
+                    cost=cost,
+                    available=upgrades_available,
+                )
             # Elastic coordination: a registered workload is offered the
             # slice BEFORE any disruptive action.  The slot claim above is
             # kept through the negotiation — decline/timeout falls back to
@@ -1583,6 +1783,8 @@ class ClusterUpgradeStateManager:
             group.nodes, self.keys.elastic_excluded_annotation, TRUE_STRING
         )
         self._clear_elastic_negotiation(group)
+        if self.trace_recorder is not None:
+            self.trace_recorder.end_wait(group, "negotiate")
         self.elastic_negotiations["accept"] += 1
         self.elastic_resizes["down"] += 1
         if offer_start is not None:
@@ -1640,6 +1842,10 @@ class ClusterUpgradeStateManager:
                     self.budget_ledger.release(group.id)
                 continue
             start = group_clock_start(self.provider, group, offer_key, now)
+            if self.trace_recorder is not None:
+                # Idempotent: a restarted controller resuming the same
+                # offer clock re-opens the same negotiation wait span.
+                self.trace_recorder.begin_wait(group, "negotiate")
             if start is None:
                 # Offer freshly posted this pass; the workload answers on
                 # a later one.
@@ -1692,6 +1898,8 @@ class ClusterUpgradeStateManager:
             # fallback slice is annotation-identical to a pre-coordination
             # roll (same downstream events, same budget charge).
             self._clear_elastic_negotiation(group)
+            if self.trace_recorder is not None:
+                self.trace_recorder.end_wait(group, "negotiate")
             self.provider.change_nodes_upgrade_state(
                 group.nodes, UpgradeState.CORDON_REQUIRED
             )
@@ -2012,11 +2220,21 @@ class ClusterUpgradeStateManager:
                     self.provider.change_nodes_upgrade_annotation(
                         carriers, window_key, "null"
                     )
+                    if self.trace_recorder is not None:
+                        self.trace_recorder.end_wait(group, "window")
                     logger.info(
                         "group %s maintenance window open; resuming",
                         group.id,
                     )
                 continue
+            if self.trace_recorder is not None and group.effective_state(
+                self.keys.state_label
+            ) not in (UpgradeState.DONE, UpgradeState.UNKNOWN):
+                # Only in-roll groups earn a window-hold wait span; a
+                # DONE group held by a closed window is not roll time.
+                self.trace_recorder.begin_wait(
+                    group, "window", pool=pool_name
+                )
             if len(carriers) != group.size():
                 with self.provider.batched():
                     self.provider.change_nodes_upgrade_annotation(
@@ -2171,6 +2389,7 @@ class ClusterUpgradeStateManager:
                         self.provider.change_nodes_upgrade_state(
                             group.nodes, UpgradeState.QUARANTINED
                         )
+                    trace_suffix = self._trace_event_suffix()
                     for node in group.nodes:
                         log_event(
                             self.event_recorder,
@@ -2180,8 +2399,14 @@ class ClusterUpgradeStateManager:
                             f"Slice quarantined mid-upgrade: {reason}; "
                             "unavailability budget released; the roll "
                             "resumes after all hosts stay Ready for "
-                            f"{dwell_s}s",
+                            f"{dwell_s}s{trace_suffix}",
                         )
+                    # Losing hardware mid-roll is a black-box moment:
+                    # capture the ring + span tree while the evidence
+                    # (deltas, budget verdicts) is still in the buffer.
+                    self._flightrec_trigger(
+                        "quarantine", group=group.id, detail=reason
+                    )
                     self.quarantines_total += 1
                     self.quarantine_reasons[group.id] = (
                         f"quarantined: {reason}"
@@ -2469,6 +2694,10 @@ class ClusterUpgradeStateManager:
                 self.keys.elastic_offer_annotation,
                 self.keys.elastic_response_annotation,
                 self.keys.elastic_resize_complete_annotation,
+                # Trace anchor: the DONE-flip intent already deletes it
+                # (annotation_source); this catches nodes whose terminal
+                # write raced a crash and kept a stale anchor.
+                self.keys.trace_annotation,
             ):
                 carriers = [
                     m.node for m in group.members if key in m.node.annotations

@@ -169,6 +169,9 @@ class ValidationManager:
         self.fence = None
         self.term_fence = None
         self.rung_store = None
+        # Roll tracing (obs/trace.py): fanned in by the state
+        # manager; feeds eviction-rung entries into the span tree.
+        self.trace_recorder = None
         # -- async (pipelined) probing ----------------------------------
         # A prober that marks itself ``async_probe = True`` (the fused
         # device battery — real XLA work, up to seconds even warm) runs
@@ -331,6 +334,8 @@ class ValidationManager:
         if not result.healthy:
             logger.info("group %s validation pending: %s", group.id, result.detail)
             self.last_rejection[group.id] = result.detail
+            if self.trace_recorder is not None:
+                self.trace_recorder.note_gate(group, result.detail)
             self._handle_timeout(group)
             return False
         self.last_rejection.pop(group.id, None)
@@ -475,6 +480,11 @@ class ValidationManager:
             escalation_stats=self.escalation_stats,
             fence=self.fence,
             rung_store=self.rung_store,
+            trace_hook=(
+                self.trace_recorder.rung_entered
+                if self.trace_recorder is not None
+                else None
+            ),
         )
         node_names = [n.name for n in group.nodes]
         had_failed_before = group.id in self.pending_rollback

@@ -66,6 +66,10 @@ def _bench_shaped_summary() -> dict:
         "fused_battery_warm_s": 0.123,
         "fused_battery_cache_hit": True,
         "fused_battery_fallbacks": 0,
+        "tracing_overhead_pct": 12.345,
+        "tracing_bucket_sum_error_pct": 0.123,
+        "tracing_idle_writes": 0,
+        "tracing_spool_bytes": 123456,
         "packed_vs_greedy_waves": [123, 123],
         "packed_engine_agrees": True,
         "packed_idle_ticks": 12,

@@ -1,0 +1,668 @@
+"""Roll tracing tier: span recorder, multicast transition observers,
+critical-path attribution, flight recorder, and crash continuity.
+
+The tracing subsystem is observe-only by contract — every test here
+also pins the fail-open side: a recorder fault may cost a span (counted
+in ``drops``) but can never block a state transition, and a controller
+crash mid-roll continues the SAME trace under the new incarnation with
+exactly the in-flight spans re-opened (see docs/observability.md)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.obs import (
+    CompletedTrace,
+    FlightRecorder,
+    Span,
+    TraceRecorder,
+    analyze,
+    format_anchor,
+    makespan_breakdown,
+    parse_anchor,
+    phase_drift,
+    redact,
+    render_breakdown,
+    render_tree,
+)
+from k8s_operator_libs_tpu.obs.critical import (
+    BUCKET_BUDGET,
+    BUCKET_IDLE,
+    BUCKET_PHASE,
+)
+from k8s_operator_libs_tpu.obs.trace import (
+    KIND_PHASE,
+    KIND_POOL,
+    KIND_ROLL,
+    KIND_WAIT,
+    WAIT_WINDOW,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.node_state_provider import (
+    NodeUpgradeStateProvider,
+)
+from tests.fixtures import (
+    ClusterFixture,
+    DRIVER_LABELS,
+    NAMESPACE,
+    make_node,
+)
+
+KEYS = UpgradeKeys()
+
+
+class _N:
+    """Bare named node stand-in (the recorder only reads ``.name``)."""
+
+    def __init__(self, name):
+        self.name = name
+
+
+def _recorder(t0=100.0):
+    """Recorder on injected clocks so tests control every timestamp."""
+    clock = {"t": t0, "epoch": 1_000_000.0}
+
+    rec = TraceRecorder(
+        clock=lambda: clock["t"],
+        epoch_clock=lambda: clock["epoch"] + clock["t"],
+    )
+    return rec, clock
+
+
+# -- satellite: multicast transition observers -------------------------------
+
+
+def _provider(cluster):
+    return NodeUpgradeStateProvider(
+        cluster, KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+
+
+def test_two_observers_both_fire_once_per_group_transition():
+    cluster = FakeCluster()
+    nodes = [cluster.create_node(make_node(f"n{i}")) for i in range(2)]
+    provider = _provider(cluster)
+    seen_a, seen_b = [], []
+    provider.add_transition_observer(
+        lambda ns, st: seen_a.append((sorted(n.name for n in ns), st))
+    )
+    provider.add_transition_observer(
+        lambda ns, st: seen_b.append((sorted(n.name for n in ns), st))
+    )
+    provider.change_nodes_upgrade_state(
+        nodes, UpgradeState.CORDON_REQUIRED
+    )
+    expected = [(["n0", "n1"], UpgradeState.CORDON_REQUIRED)]
+    assert seen_a == expected
+    assert seen_b == expected
+
+
+def test_raising_observer_is_isolated_and_never_blocks_the_transition():
+    cluster = FakeCluster()
+    node = cluster.create_node(make_node("n0"))
+    provider = _provider(cluster)
+    seen = []
+
+    def bad(ns, st):
+        raise RuntimeError("observer bug")
+
+    provider.add_transition_observer(bad)
+    provider.add_transition_observer(lambda ns, st: seen.append(st))
+    provider.change_nodes_upgrade_state([node], UpgradeState.CORDON_REQUIRED)
+    # The transition itself went through AND the second observer fired.
+    assert node.labels[KEYS.state_label] == "cordon-required"
+    assert seen == [UpgradeState.CORDON_REQUIRED]
+
+
+def test_single_slot_property_is_back_compat_and_replaces_the_list():
+    provider = _provider(FakeCluster())
+    a = lambda ns, st: None  # noqa: E731
+    b = lambda ns, st: None  # noqa: E731
+    provider.add_transition_observer(a)
+    provider.add_transition_observer(a)  # dedupe
+    provider.add_transition_observer(b)
+    assert provider._transition_observers == [a, b]
+    assert provider.transition_observer is a
+    # Legacy assignment replaces the whole list (documented hazard).
+    provider.transition_observer = b
+    assert provider._transition_observers == [b]
+    provider.transition_observer = None
+    assert provider.transition_observer is None
+    provider.add_transition_observer(None)  # ignored
+    assert provider._transition_observers == []
+    provider.remove_transition_observer(b)  # absent: no-op
+
+
+# -- recorder: deterministic ids, idempotency, waits -------------------------
+
+
+def test_roll_tree_grows_from_group_transitions_with_deterministic_ids():
+    rec, clock = _recorder()
+    nodes = [_N("host-0"), _N("host-1")]
+    rec.seed_pools({"host-0": "pool-0", "host-1": "pool-0"})
+    rec.observe_group_transition(nodes, UpgradeState.UPGRADE_REQUIRED)
+    trace_id = rec.active_trace_id()
+    assert trace_id and trace_id.startswith("roll-")
+    # Queued: a budget wait is open under the group.
+    kinds = {s.span_id: s for s in rec.spans()}
+    assert f"{trace_id}/pool-0/host-0/wait:budget" in kinds
+    clock["t"] += 5.0
+    rec.begin_admission_pass()
+    rec.observe_group_transition(nodes, UpgradeState.CORDON_REQUIRED)
+    spans = {s.span_id: s for s in rec.spans()}
+    wait = spans[f"{trace_id}/pool-0/host-0/wait:budget"]
+    assert not wait.open and wait.duration() == pytest.approx(5.0)
+    phase = spans[f"{trace_id}/pool-0/host-0/cordon-required"]
+    assert phase.open and phase.kind == KIND_PHASE
+    # Admission hung the group under wave-1.
+    group = spans[f"{trace_id}/pool-0/host-0"]
+    assert group.parent_id == f"{trace_id}/pool-0/wave-1"
+    # Idempotent re-issue (crash replay / re-drive): nothing new.
+    n_before = len(spans)
+    rec.observe_group_transition(nodes, UpgradeState.CORDON_REQUIRED)
+    assert len(rec.spans()) == n_before
+    assert rec.drops == 0
+
+
+def test_repeated_quarantine_gets_occurrence_suffix_not_duplicate():
+    rec, clock = _recorder()
+    nodes = [_N("a0")]
+    rec.observe_group_transition(nodes, UpgradeState.CORDON_REQUIRED)
+    for _ in range(2):
+        clock["t"] += 1.0
+        rec.observe_group_transition(nodes, UpgradeState.QUARANTINED)
+        clock["t"] += 1.0
+        rec.observe_group_transition(nodes, UpgradeState.DRAIN_REQUIRED)
+    quarantines = [
+        s for s in rec.spans() if s.name == "wait:quarantine"
+    ]
+    assert len(quarantines) == 2
+    base = [s for s in quarantines if "#" not in s.span_id]
+    second = [s for s in quarantines if s.span_id.endswith("#2")]
+    assert len(base) == 1 and len(second) == 1
+    assert all(not s.open for s in quarantines)
+
+
+def test_begin_end_wait_and_terminal_close():
+    rec, clock = _recorder()
+    nodes = [_N("b0"), _N("b1")]
+    rec.observe_group_transition(nodes, UpgradeState.CORDON_REQUIRED)
+    rec.begin_wait(nodes, WAIT_WINDOW, window="nights")
+    clock["t"] += 3.0
+    rec.end_wait(nodes, WAIT_WINDOW)
+    window = [s for s in rec.spans() if s.name == "wait:window"]
+    assert len(window) == 1 and not window[0].open
+    assert window[0].duration() == pytest.approx(3.0)
+    assert window[0].attrs == {"window": "nights"}
+    # DONE closes the group subtree; only roll+pool stay open.
+    rec.observe_group_transition(nodes, UpgradeState.DONE)
+    open_kinds = {s.kind for s in rec.spans() if s.open}
+    assert open_kinds == {KIND_ROLL, KIND_POOL}
+
+
+def test_rung_ladder_records_node_and_rung_wait_spans():
+    rec, clock = _recorder()
+    nodes = [_N("c0"), _N("c1")]
+    rec.observe_group_transition(nodes, UpgradeState.DRAIN_REQUIRED)
+    rec.rung_entered("c1", "evict")
+    rec.rung_entered("c1", "evict")  # idempotent re-entry
+    clock["t"] += 2.0
+    rec.rung_entered("c1", "delete")  # escalation closes the prior rung
+    waits = {
+        s.name: s for s in rec.spans() if s.kind == KIND_WAIT
+    }
+    assert not waits["wait:evict:evict"].open
+    assert waits["wait:evict:evict"].duration() == pytest.approx(2.0)
+    assert waits["wait:evict:delete"].open
+    # Leaving DRAIN retires the ladder and the node span.
+    rec.observe_group_transition(nodes, UpgradeState.POD_RESTART_REQUIRED)
+    assert all(
+        not s.open
+        for s in rec.spans()
+        if s.kind == KIND_WAIT and s.name.startswith("wait:evict:")
+    )
+
+
+def test_fail_open_counts_drops_instead_of_raising():
+    rec, _ = _recorder()
+    rec.observe_group_transition(42, UpgradeState.CORDON_REQUIRED)
+    assert rec.drops == 1
+    rec.seed_pools(42)  # not a mapping
+    assert rec.drops == 2
+    # Span cap: overflow drops, never raises.
+    capped, _ = _recorder()
+    capped.max_spans = 2
+    capped.observe_group_transition(
+        [_N("d0")], UpgradeState.CORDON_REQUIRED
+    )
+    assert capped.drops > 0
+
+
+def test_maybe_end_roll_waits_for_all_groups_then_snapshots_and_resets():
+    rec, clock = _recorder()
+    g1, g2 = [_N("e0")], [_N("f0")]
+    rec.observe_group_transition(g1, UpgradeState.CORDON_REQUIRED)
+    rec.observe_group_transition(g2, UpgradeState.CORDON_REQUIRED)
+    trace_id = rec.active_trace_id()
+    clock["t"] += 1.0
+    rec.observe_group_transition(g1, UpgradeState.DONE)
+    assert rec.maybe_end_roll() is None  # g2 still in flight
+    clock["t"] += 1.0
+    rec.observe_group_transition(g2, UpgradeState.DONE)
+    done = rec.maybe_end_roll()
+    assert isinstance(done, CompletedTrace)
+    assert done.trace_id == trace_id
+    assert done.makespan == pytest.approx(2.0)
+    assert all(s.end is not None for s in done.spans)
+    # Recorder reset for the next roll; snapshot retained.
+    assert rec.active_trace_id() is None
+    assert rec.open_span_count() == 0
+    assert rec.last_completed() is done
+    assert rec.maybe_end_roll() is None
+
+
+# -- crash durability: anchors + reopen --------------------------------------
+
+
+def test_anchor_round_trip_and_garbage_tolerance():
+    anchor = format_anchor("roll-123", "drain-required", 1700000000.25)
+    assert parse_anchor(anchor) == (
+        "roll-123", "drain-required", pytest.approx(1700000000.25)
+    )
+    for garbage in (
+        None, "", "a|b", "a|b|c|d", "a|b|notafloat", "|x|5", "x||5"
+    ):
+        assert parse_anchor(garbage) is None
+
+
+def test_annotation_source_writes_anchor_and_deletes_on_terminal():
+    rec, _ = _recorder()
+    rec.annotation_key = KEYS.trace_annotation
+    node = _N("g0")
+    # Outside a roll there is nothing to anchor.
+    assert rec.annotation_source(node, UpgradeState.CORDON_REQUIRED) == {}
+    rec.observe_group_transition([node], UpgradeState.CORDON_REQUIRED)
+    patch = rec.annotation_source(node, UpgradeState.DRAIN_REQUIRED)
+    parsed = parse_anchor(patch[KEYS.trace_annotation])
+    assert parsed is not None
+    assert parsed[0] == rec.active_trace_id()
+    assert parsed[1] == "drain-required"
+    # Terminal flip deletes the anchor in the same intent.
+    assert rec.annotation_source(node, UpgradeState.DONE) == {
+        KEYS.trace_annotation: None
+    }
+
+
+def test_reopen_group_continues_the_persisted_trace_idempotently():
+    rec, _ = _recorder()
+    anchor = format_anchor("roll-999000", "drain-required", 999_060.0)
+    nodes = [_N("h0"), _N("h1")]
+    assert rec.reopen_group(
+        nodes, anchor, pool="pool-7", adopted_by="op@3", now_epoch=999_120
+    )
+    assert rec.active_trace_id() == "roll-999000"
+    spans = {s.span_id: s for s in rec.spans()}
+    group = spans["roll-999000/pool-7/h0"]
+    assert group.open and group.attrs["adopted_by"] == "op@3"
+    phase = spans["roll-999000/pool-7/h0/drain-required"]
+    assert phase.open and phase.attrs.get("reopened")
+    # The roll span start was rebased from the id's epoch: the group's
+    # 60 s of pre-crash history is preserved relative to the roll.
+    roll = spans["roll-999000"]
+    assert phase.start - roll.start == pytest.approx(60.0, abs=1.0)
+    # Idempotent re-adopt records nothing new.
+    n = len(spans)
+    assert not rec.reopen_group(nodes, anchor, pool="pool-7")
+    assert len(rec.spans()) == n
+    # The engine's idempotent re-drive of the anchored state is a no-op
+    # too; the NEXT transition continues the phase chain.
+    rec.observe_group_transition(nodes, UpgradeState.DRAIN_REQUIRED)
+    assert len(rec.spans()) == n
+    rec.observe_group_transition(nodes, UpgradeState.POD_RESTART_REQUIRED)
+    assert not phase.open
+    # Garbage anchors and foreign-trace leftovers are refused.
+    assert not rec.reopen_group(nodes, "not-an-anchor")
+    assert not rec.reopen_group(
+        [_N("z9")], format_anchor("roll-111", "drain-required", 111.0)
+    )
+    assert rec.active_trace_id() == "roll-999000"
+
+
+# -- critical-path attribution ----------------------------------------------
+
+
+def _span(span_id, kind, name, start, end, parent=None):
+    return Span(
+        span_id=span_id,
+        trace_id="roll-1",
+        parent_id=parent,
+        kind=kind,
+        name=name,
+        start=start,
+        end=end,
+    )
+
+
+def test_attribution_buckets_sum_exactly_to_makespan():
+    # 0..10 roll: phase 0..4, budget wait 3..7 (wait preferred on the
+    # overlap), gap 7..9 (idle), phase 9..10.
+    spans = [
+        _span("roll-1", KIND_ROLL, "roll-1", 0.0, 10.0),
+        _span("roll-1/p/g/cordon-required", KIND_PHASE,
+              "cordon-required", 0.0, 4.0),
+        _span("roll-1/p/g/wait:budget", KIND_WAIT, "wait:budget",
+              3.0, 7.0),
+        _span("roll-1/p/g2/drain-required", KIND_PHASE,
+              "drain-required", 9.0, 10.0),
+    ]
+    trace = CompletedTrace("roll-1", 0.0, 10.0, spans)
+    out = analyze(trace)
+    assert out.bucket_total() == pytest.approx(out.makespan, abs=1e-9)
+    assert out.buckets[BUCKET_BUDGET] == pytest.approx(4.0)
+    assert out.buckets[BUCKET_PHASE] == pytest.approx(4.0)
+    assert out.buckets[BUCKET_IDLE] == pytest.approx(2.0)
+    # Segments are chronological and also tile the makespan exactly.
+    assert [s.bucket for s in out.segments] == [
+        BUCKET_PHASE, BUCKET_BUDGET, BUCKET_IDLE, BUCKET_PHASE
+    ]
+    assert sum(s.seconds for s in out.segments) == pytest.approx(10.0)
+
+
+def test_breakdown_block_drift_and_renderings():
+    spans = [
+        _span("roll-1", KIND_ROLL, "roll-1", 0.0, 6.0),
+        _span("roll-1/pool-0/g/drain-required", KIND_PHASE,
+              "drain-required", 0.0, 6.0),
+    ]
+    out = analyze(CompletedTrace("roll-1", 0.0, 6.0, spans))
+    drift = phase_drift(
+        out,
+        lambda pool, phase: 2.0 if phase == "drain-required" else None,
+    )
+    assert len(drift) == 1
+    assert drift[0].pool == "pool-0"
+    assert drift[0].excess_s == pytest.approx(4.0)
+    block = makespan_breakdown(out, drift=drift)
+    assert block["traceId"] == "roll-1"
+    assert block["makespanSeconds"] == pytest.approx(6.0)
+    assert block["buckets"]["phaseSeconds"] == pytest.approx(6.0)
+    assert block["criticalPath"][0]["span"] == "drain-required"
+    assert block["topDrift"][0]["excessSeconds"] == pytest.approx(4.0)
+    tree = render_tree(CompletedTrace("roll-1", 0.0, 6.0, spans))
+    assert "roll-1" in tree and "drain-required" in tree
+    text = render_breakdown(block)
+    assert "makespan" in text and "drain-required" in text
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_ring_is_bounded_and_redaction_scrubs_secret_shaped_keys():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.note("tick", n=i)
+    assert fr.ring_size() == 4
+    scrubbed = redact({
+        "api_token": "s3cr3t",
+        "nested": [{"Authorization": "Bearer xyz", "ok": 1}],
+        "plain": "visible",
+    })
+    assert scrubbed["api_token"] == "[REDACTED]"
+    assert scrubbed["nested"][0]["Authorization"] == "[REDACTED]"
+    assert scrubbed["plain"] == "visible"
+
+
+def test_trigger_throttles_per_reason_and_enforces_spool_cap(tmp_path):
+    clock = {"t": 0.0}
+    fr = FlightRecorder(
+        spool_dir=str(tmp_path),
+        spool_cap_bytes=16 * 1024,
+        throttle_s=60.0,
+        clock=lambda: clock["t"],
+    )
+    fr.snapshot_providers["boom"] = lambda: 1 / 0  # partial snapshots ok
+    path = fr.trigger("stuck", group="g0", api_token="leak-me")
+    assert path is not None
+    assert fr.trigger("stuck") is None  # throttled
+    assert fr.trigger("infeasible") is not None  # per-reason clocks
+    clock["t"] += 61.0
+    assert fr.trigger("stuck") is not None  # window elapsed
+    assert fr.dumps_total == {"stuck": 2, "infeasible": 1}
+    assert fr.throttled_total == 1
+    snap = json.loads(open(fr.spool_files()[0], "rb").read())
+    assert snap["context"]["api_token"] == "[REDACTED]"
+    assert snap["boom"] == {"error": "division by zero"}
+    # Event storm with throttling off: the byte cap holds by shedding
+    # oldest dumps, and dumping keeps working.
+    fr.throttle_s = 0.0
+    fr.note("filler", payload="x" * 512)
+    for _ in range(200):
+        fr.trigger("infeasible")
+    assert fr.spool_bytes() <= fr.spool_cap_bytes
+    assert fr.spool_files(), "cap enforcement deleted everything"
+    assert fr.dumps_total["infeasible"] == 201
+
+
+def test_flight_recorder_without_spool_dir_is_memory_only():
+    fr = FlightRecorder()
+    assert fr.trigger("stuck") is None
+    assert fr.dumps_total == {"stuck": 1}  # counted even with no disk
+    assert fr.spool_bytes() == 0 and fr.spool_files() == []
+
+
+# -- acceptance: full fake-tier roll -----------------------------------------
+
+
+def _traced_roll(slices=2, hosts=2, max_ticks=400):
+    cluster = FakeCluster()
+    keys = UpgradeKeys()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    names = []
+    for i in range(slices):
+        for n in fx.tpu_slice(f"pool-{i:02d}", hosts=hosts):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+            names.append(n.name)
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString("50%"),
+        drain_spec=DrainSpec(enable=False),
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    # Pool attribution, as the controller seeds it each reconcile.
+    manager.trace_recorder.seed_pools(
+        {name: name.rsplit("-w", 1)[0] for name in names}
+    )
+    for _ in range(max_ticks):
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        manager.apply_state(state, policy)
+        manager.wait_for_async_work(10.0)
+        if manager.trace_recorder.last_completed() is not None:
+            break
+    else:
+        pytest.fail("roll never completed a trace")
+    return cluster, manager, manager.trace_recorder.last_completed()
+
+
+def test_full_roll_yields_one_connected_tree_with_exact_attribution():
+    cluster, manager, trace = _traced_roll(slices=2, hosts=2)
+    rec = manager.trace_recorder
+    assert rec.drops == 0
+    assert rec.open_span_count() == 0  # recorder reset after the roll
+    by_id = {s.span_id: s for s in trace.spans}
+    roots = [s for s in trace.spans if s.parent_id is None]
+    assert [s.kind for s in roots] == [KIND_ROLL]
+    for span in trace.spans:
+        assert span.end is not None, f"open span in completed trace: {span}"
+        if span.parent_id is not None:
+            assert span.parent_id in by_id, f"orphan span {span.span_id}"
+    groups = [s for s in trace.spans if s.kind == "group"]
+    assert len(groups) == 2
+    pools = {s.name for s in trace.spans if s.kind == "pool"}
+    assert pools == {"pool-00", "pool-01"}
+    # Every occupied phase state shows up as a phase span per group.
+    phases = {s.name for s in trace.spans if s.kind == KIND_PHASE}
+    assert "cordon-required" in phases
+    # max_parallel=1 serializes the slices: each pool runs its own
+    # wave-1 and the slice admitted second queued under a budget wait.
+    waves = [s for s in trace.spans if s.kind == "wave"]
+    assert len(waves) == 2
+    assert {s.span_id.split("/")[1] for s in waves} == {
+        "pool-00", "pool-01"
+    }
+    assert any(s.name == "wait:budget" for s in trace.spans)
+    # Acceptance gate: buckets sum to the makespan (within 1%).
+    out = analyze(trace)
+    assert out.group_count == 2
+    assert out.bucket_total() == pytest.approx(
+        trace.makespan, rel=0.01, abs=1e-6
+    )
+    block = makespan_breakdown(out)
+    assert block["traceId"] == trace.trace_id
+    assert set(block["buckets"]) == {
+        "phaseSeconds", "budgetWaitSeconds", "windowHoldSeconds",
+        "quarantineSeconds", "negotiationSeconds", "apiRetrySeconds",
+        "idleSeconds",
+    }
+    # The durable anchors were deleted by the terminal flips.
+    for name in ("pool-00-w0", "pool-01-w0"):
+        node = cluster.get_node(name, cached=False)
+        assert KEYS.trace_annotation not in node.annotations
+
+
+# -- chaos: crash mid-roll at 3+ points, same trace continues ----------------
+
+
+def test_trace_survives_controller_crashes_at_three_points():
+    """Kill the controller pre-apply, post-apply, and mid-async-work:
+    each new incarnation must continue the SAME trace id from the
+    durable anchors, re-open exactly the in-flight groups, leave zero
+    orphan open spans, and finish with no duplicate phase spans."""
+    from tests.test_chaos import ControllerCrasher, _sliced_upgrade_scenario
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    slices = _sliced_upgrade_scenario(store, keys, slices=3, hosts=2)
+    nodes = [n for ns in slices.values() for n in ns]
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString(1),
+        unavailability_unit="slice",
+        drain_spec=DrainSpec(enable=False),
+    )
+    crasher = ControllerCrasher(store, keys, policy)
+    terminal = {"", "upgrade-done"}
+
+    def anchored_groups():
+        """Slice groups whose members carry a durable trace anchor."""
+        out = set()
+        for name, members in slices.items():
+            for n in members:
+                live = store.get_node(n.name, cached=False)
+                if keys.trace_annotation in live.annotations:
+                    out.add(name)
+                    break
+        return out
+
+    def tick_until_in_flight(max_ticks=100):
+        for _ in range(max_ticks):
+            crasher.tick()
+            if anchored_groups():
+                return
+        pytest.fail("roll never produced an anchored in-flight group")
+
+    def assert_no_orphan_open_spans(rec):
+        spans = {s.span_id: s for s in rec.spans()}
+        for s in spans.values():
+            if not s.open or s.kind == KIND_ROLL:
+                continue
+            seen = set()
+            cur = s
+            while cur.parent_id is not None:
+                assert cur.parent_id in spans, (
+                    f"open span {s.span_id} detached at {cur.span_id}"
+                )
+                assert cur.span_id not in seen
+                seen.add(cur.span_id)
+                cur = spans[cur.parent_id]
+            assert cur.kind == KIND_ROLL, f"rootless open span {s.span_id}"
+
+    tick_until_in_flight()
+    trace_id = crasher.mgr.trace_recorder.active_trace_id()
+    assert trace_id is not None
+
+    for style in ("pre-apply", "post-apply", "mid-async"):
+        if style == "mid-async":
+            crasher.tick(wait=False)
+            crasher.kill(style)
+        else:
+            crasher.tick(kill=style)
+        # Adoption happens on the fresh incarnation's first tick; crash
+        # it nowhere so the re-opened tree is inspectable.
+        expected_groups = anchored_groups()
+        assert expected_groups, f"no in-flight group at {style} kill"
+        crasher.tick()
+        rec = crasher.mgr.trace_recorder
+        assert rec.active_trace_id() == trace_id, (
+            f"{style}: trace did not continue"
+        )
+        assert crasher.adopt_summaries[-1]["traces"] >= 1
+        # Exactly the anchored slices were re-opened — group span names
+        # are member node names, so map them back to their slice.
+        slice_of = {
+            n.name: name for name, ns in slices.items() for n in ns
+        }
+        reopened = {
+            slice_of[s.name]
+            for s in rec.spans()
+            if s.kind == "group" and s.attrs.get("reopened")
+            and s.name in slice_of
+        }
+        assert expected_groups <= reopened
+        assert_no_orphan_open_spans(rec)
+        # Keep the roll moving so the next crash point lands mid-roll.
+        tick_until_in_flight()
+
+    # Converge and close the trace on the final incarnation.
+    for _ in range(300):
+        crasher.tick()
+        done = crasher.mgr.trace_recorder.last_completed()
+        if done is not None:
+            break
+    else:
+        pytest.fail("roll never converged after the crash gauntlet")
+    assert done.trace_id == trace_id
+    assert crasher.mgr.trace_recorder.open_span_count() == 0
+    # Deterministic ids made every post-crash re-record a no-op: a
+    # duplicate phase span would carry an occurrence suffix.
+    dup_phases = [
+        s.span_id
+        for s in done.spans
+        if s.kind == KIND_PHASE and "#" in s.span_id
+    ]
+    assert not dup_phases, f"duplicate phase spans: {dup_phases}"
+    for n in nodes:
+        live = store.get_node(n.name, cached=False)
+        assert live.labels[keys.state_label] == "upgrade-done"
+        assert keys.trace_annotation not in live.annotations
+    # Dead incarnations stayed dead: frozen mutation counts never moved.
+    for client, frozen in crasher.dead:
+        assert client.mutations == frozen

@@ -104,16 +104,29 @@ def main(argv=None) -> int:
 
     prof = cProfile.Profile()
     prof.enable()
-    tick(manager, policy, namespace, labels)
-    prof.disable()
+    failure: Exception | None = None
+    try:
+        tick(manager, policy, namespace, labels)
+    except Exception as e:  # noqa: BLE001 — report the partial profile
+        failure = e
+    finally:
+        # Without the finally, a tick that raises leaves the profiler
+        # enabled and every later frame (argparse teardown, interpreter
+        # exit) pollutes the sample — and nothing at all gets printed.
+        prof.disable()
 
     print(
         f"profile: one {N_SLICES * HOSTS_PER_SLICE}-node active-roll "
         f"tick (top {args.top} by {args.sort})"
     )
+    if failure is not None:
+        print(
+            f"tick FAILED mid-profile ({failure!r}); partial profile "
+            "up to the failure point:"
+        )
     stats = pstats.Stats(prof, stream=sys.stdout)
     stats.strip_dirs().sort_stats(args.sort).print_stats(args.top)
-    return 0
+    return 1 if failure is not None else 0
 
 
 if __name__ == "__main__":

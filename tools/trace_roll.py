@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Drive one fake-tier roll with tracing on and print the span tree.
+
+`make trace` — rolls a small FakeCluster fleet end to end through the
+real engine with the TraceRecorder enabled, then prints the completed
+causal span tree (roll -> pool -> wave -> slice-group -> phase/wait)
+and its critical-path makespan attribution.  The quickest way to SEE
+what obs/trace.py + obs/critical.py produce without standing up a
+controller; the same rendering the status CLI shows for a live roll.
+
+Zero external dependencies; everything comes from the repo's own test
+fixtures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+N_SLICES = 4
+HOSTS_PER_SLICE = 4
+ROLL_BUDGET_S = 120.0
+
+
+def run_traced_roll(slices: int, hosts: int):
+    """Roll a fresh fleet to upgrade-done; returns (manager, trace)."""
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    names = []
+    for i in range(slices):
+        for n in fx.tpu_slice(f"pool-{i:02d}", hosts=hosts):
+            fx.driver_pod(n, ds, hash_suffix="v1")
+            names.append(n.name)
+    fx.bump_daemon_set_template(ds, "v2", revision=2)
+    fx.auto_recreate_driver_pods(ds, "v2")
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=2,
+        max_unavailable=IntOrString("50%"),
+        drain_spec=DrainSpec(enable=False),
+    )
+    manager = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    deadline = time.monotonic() + ROLL_BUDGET_S
+    while time.monotonic() < deadline:
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        manager.apply_state(state, policy)
+        manager.wait_for_async_work(30.0)
+        if all(
+            cluster.get_node(n, cached=False).labels.get(keys.state_label)
+            == UpgradeState.DONE.value
+            for n in names
+        ):
+            break
+    else:
+        raise RuntimeError("roll did not converge inside its budget")
+    # Settling ticks: the closing maybe_end_roll runs on the apply pass
+    # AFTER the last async state flip lands.
+    for _ in range(2):
+        state = manager.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        manager.apply_state(state, policy)
+        manager.wait_for_async_work(10.0)
+    recorder = manager.trace_recorder
+    trace = recorder.last_completed() if recorder is not None else None
+    if trace is None:
+        raise RuntimeError("roll completed but produced no trace")
+    return manager, trace
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--slices", type=int, default=N_SLICES, help="slice-group count"
+    )
+    parser.add_argument(
+        "--hosts", type=int, default=HOSTS_PER_SLICE, help="hosts per slice"
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the makespanBreakdown block as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    from k8s_operator_libs_tpu.obs.critical import (
+        analyze,
+        makespan_breakdown,
+        render_breakdown,
+        render_tree,
+    )
+
+    _, trace = run_traced_roll(args.slices, args.hosts)
+    attribution = analyze(trace)
+    breakdown = makespan_breakdown(attribution)
+    if args.json:
+        print(json.dumps(breakdown, indent=2, sort_keys=True))
+        return 0
+    print(
+        f"traced roll: {args.slices} slice(s) x {args.hosts} host(s), "
+        f"{len(trace.spans)} spans"
+    )
+    print()
+    print(render_tree(trace))
+    print()
+    print(render_breakdown(breakdown))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
