@@ -13,7 +13,7 @@ BUILDIMAGE ?= $(IMAGE)-devel:$(TAG)
 
 .PHONY: all test test-fast chaos lint typecheck cov-report bench \
 	bench-guard graft-check clean generate generate-check docker-build \
-	docker-push .build-image plan whatif profile trace
+	docker-push .build-image plan whatif profile trace health-report
 
 all: lint test
 
@@ -124,6 +124,14 @@ profile:
 # docs/observability.md).
 trace:
 	$(PYTHON) tools/trace_roll.py
+
+# Fleet health report: per-generation probe baselines, the node
+# health-score distribution and any confirmed stragglers — from a live
+# controller (ARGS="--metrics-url http://host:port/metrics") or, by
+# default, a synthetic mixed-generation fleet (see docs/observability.md
+# "Fleet health telemetry").
+health-report:
+	$(PYTHON) tools/health_report.py $(ARGS)
 
 graft-check:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \

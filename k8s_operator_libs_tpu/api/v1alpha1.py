@@ -327,6 +327,10 @@ class SliceHealthGateSpec(_SpecBase):
     # Overall validation deadline before the slice is marked failed
     # (reference validation_manager.go:32 uses a fixed 600s).
     timeout_second: int = 600
+    # Route confirmed fleet-health stragglers (sustained below-baseline
+    # probe telemetry) into the slice-quarantine path.  Off by default:
+    # the telemetry plane is observe-only unless the operator opts in.
+    quarantine_stragglers: bool = False
 
     def validate(self) -> None:
         if not (0.0 <= self.min_reformation_fraction <= 1.0):

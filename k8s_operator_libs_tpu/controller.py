@@ -55,7 +55,12 @@ from k8s_operator_libs_tpu.upgrade.upgrade_state import (
     BuildStateError,
     ClusterUpgradeStateManager,
 )
-from k8s_operator_libs_tpu.upgrade.util import EventRecorder, UpgradeKeys
+from k8s_operator_libs_tpu.upgrade.util import (
+    EVENT_TYPE_WARNING,
+    EventRecorder,
+    UpgradeKeys,
+    log_event,
+)
 
 logger = get_logger(__name__)
 
@@ -136,6 +141,10 @@ class ControllerConfig:
     # flightrec.py).  None = <tmpdir>/tpu-upgrade-blackbox; "" disables
     # the on-disk spool (ring + triggers still run in memory).
     trace_spool_dir: Optional[str] = None
+    # Address the metrics/healthz server binds.  Loopback by default:
+    # exposing the scrape endpoint beyond the pod is a deployment
+    # decision ("0.0.0.0"), not a side effect of enabling metrics.
+    metrics_bind_addr: str = "127.0.0.1"
 
 
 class UpgradeController:
@@ -453,6 +462,11 @@ class UpgradeController:
                 rec = getattr(self.manager, "trace_recorder", None)
                 if rec is not None:
                     rec.seed_pools(node_pools)
+                # ... and the telemetry plane, so health baselines fold
+                # per (generation, pool) cohort instead of fleet-wide.
+                plane = getattr(self.manager, "telemetry_plane", None)
+                if plane is not None:
+                    plane.seed_pools(node_pools)
                 drift_report = self.watchdog.observe(
                     self.manager, state, self.config.policy
                 )
@@ -468,6 +482,7 @@ class UpgradeController:
             return False
         self.metrics.observe_plan(drift_report)
         self.metrics.observe_trace(self.manager, self._trace_breakdown())
+        self._observe_telemetry()
         if self.config.policy_ref is not None:
             self._update_cr_status(state)
         duration = time.monotonic() - t0
@@ -685,6 +700,49 @@ class UpgradeController:
             breakdown.get("groups", 0),
         )
         return breakdown
+
+    def _observe_telemetry(self) -> None:
+        """Fold this pass's probe telemetry into fleet baselines and
+        publish the verdicts: metric families, straggler-aware phase
+        clocks (the planner's ETA annotation), one NodeHealthDegraded
+        Warning per FRESH confirmation (stamped with the active trace
+        id), and a flight-recorder snapshot while the slow batteries
+        are still in the evidence ring.  Observe-only: nothing here
+        changes any node's upgrade state."""
+        plane = getattr(self.manager, "telemetry_plane", None)
+        if plane is None:
+            return
+        plane.recompute()
+        self.metrics.observe_telemetry(self.manager)
+        straggler_nodes = [
+            s["node"]
+            for s in plane.to_status().get("stragglers") or []
+        ]
+        self.clock_tracker.set_straggler_nodes(straggler_nodes)
+        fresh = plane.new_confirmations()
+        if not fresh:
+            return
+        suffix_fn = getattr(self.manager, "_trace_event_suffix", None)
+        trace_suffix = suffix_fn() if suffix_fn is not None else ""
+        for verdict in fresh:
+            log_event(
+                self.events,
+                verdict["node"],
+                EVENT_TYPE_WARNING,
+                "NodeHealthDegraded",
+                "Node confirmed as fleet straggler: worst stat "
+                f"{verdict['worstStat']} at z={verdict['z']} vs its "
+                f"({verdict['generation']}, {verdict['pool']}) cohort "
+                f"baseline over {verdict['streak']} consecutive "
+                f"batteries (health score {verdict['score']}); "
+                "observe-only unless healthGate.quarantineStragglers"
+                f"{trace_suffix}",
+            )
+        self.flight_recorder.trigger(
+            "straggler",
+            nodes=",".join(v["node"] for v in fresh),
+            detail=f"{len(fresh)} fresh straggler confirmation(s)",
+        )
 
     def _handle_circuit_open(self, exc: CircuitOpenError) -> None:
         """Degrade gracefully instead of crashing or wedging: log once
@@ -943,6 +1001,16 @@ class UpgradeController:
             phase_clocks = self.clock_tracker.to_status()
             if phase_clocks:
                 status["phaseClocks"] = phase_clocks
+            # Fleet health telemetry: per-cohort baselines + any
+            # confirmed stragglers (observe-only; quarantine routing is
+            # the policy's healthGate.quarantineStragglers opt-in).
+            plane = getattr(m, "telemetry_plane", None)
+            if plane is not None:
+                health = plane.to_status()
+                if health.get("healthSummary"):
+                    status["healthSummary"] = health["healthSummary"]
+                if health.get("stragglers"):
+                    status["stragglers"] = health["stragglers"]
             astats = self.manager.admission_stats
             if astats.get("last_budget_cap"):
                 status["admissionMode"] = self.manager.admission_mode
@@ -1329,7 +1397,11 @@ class UpgradeController:
     def run_forever(self) -> None:
         server = None
         if self.config.metrics_port is not None:
-            server = MetricsServer(self.registry, self.config.metrics_port)
+            server = MetricsServer(
+                self.registry,
+                self.config.metrics_port,
+                bind_addr=self.config.metrics_bind_addr,
+            )
             server.start()
         wake: Optional[threading.Event] = None
         if self.config.watch:
@@ -1441,6 +1513,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser.add_argument("--interval", type=float, default=30.0)
     parser.add_argument("--policy-file", default="")
     parser.add_argument("--metrics-port", type=int, default=None)
+    parser.add_argument(
+        "--metrics-bind-addr",
+        default="127.0.0.1",
+        help="address the /metrics + /healthz server binds "
+        "(loopback by default; use 0.0.0.0 to expose beyond the pod)",
+    )
     parser.add_argument(
         "--manage-daemonset",
         action="store_true",
@@ -1567,6 +1645,7 @@ def main(argv: Optional[list[str]] = None) -> None:
             daemonset_spec=ds_spec,
             agent_spec=agent_spec,
             metrics_port=args.metrics_port,
+            metrics_bind_addr=args.metrics_bind_addr,
             policy_ref=policy_ref,
             watch=args.watch,
             sharded=args.sharded,

@@ -1061,7 +1061,9 @@ def run_host_probe(
             d for d in devs if d.process_index == d.client.process_index()
         ]
         probe_dev = local[0] if local else devs[0]
-        add(
+        battery_checks: list[CheckResult] = []
+        t0 = time.perf_counter()
+        battery_checks.append(
             matmul_probe(
                 probe_dev,
                 n=matmul_n,
@@ -1069,7 +1071,7 @@ def run_host_probe(
                 max_iters=max_iters,
             )
         )
-        add(
+        battery_checks.append(
             hbm_bandwidth_probe(
                 probe_dev,
                 mib=hbm_mib,
@@ -1078,7 +1080,7 @@ def run_host_probe(
             )
         )
         if not skip_ici:
-            add(
+            battery_checks.append(
                 ici_allreduce_probe(
                     devs,
                     per_device_elems=allreduce_elems,
@@ -1086,7 +1088,29 @@ def run_host_probe(
                     max_iters=max_iters,
                 )
             )
-            add(ici_ring_probe(devs))
+            battery_checks.append(ici_ring_probe(devs))
+        execute_ms = (time.perf_counter() - t0) * 1e3
+        # Telemetry parity with the fused battery: stamp the same
+        # battery_* side-channel keys (with ``fused: 0.0`` — falsy, so
+        # fused-only consumers like fused_battery_telemetry still read
+        # this report as unfused) and the generation's floor metadata,
+        # so the telemetry plane is blind to which battery ran.  The
+        # unfused battery has no compile step and no cache.
+        parity = {
+            "fused": 0.0,
+            "battery_cache_hit": 0.0,
+            "battery_compile_ms": 0.0,
+            "battery_execute_ms": execute_ms,
+        }
+        kinds = sorted({d.device_kind for d in devs})
+        floors = resolve_floors(",".join(kinds))
+        if floors is not None:
+            parity["floor_mxu_tflops"] = floors.mxu_tflops
+            parity["floor_hbm_gbps"] = floors.hbm_gbps
+            parity["floor_ici_busbw_gbps"] = floors.ici_busbw_gbps
+        for check in battery_checks:
+            check.metrics.update(parity)
+            add(check)
     # The deep soak stays unfused: it is an optional post-incident /
     # periodic check with its own workload-shaped program, not part of
     # the quick gate the fusion accelerates.

@@ -52,6 +52,48 @@ def fused_battery_telemetry(checks) -> dict[str, float]:
     return {}
 
 
+def battery_telemetry(checks) -> dict[str, float]:
+    """Battery telemetry regardless of which battery ran.
+
+    The fused path stamps ``fused: 1.0`` and the unfused path stamps
+    ``fused: 0.0`` (both carry ``battery_*`` keys), so the telemetry
+    plane is blind to which battery produced a report — the presence
+    of the ``fused`` key marks a battery check, its value only says
+    which implementation ran."""
+    for c in checks:
+        if "fused" in c.metrics:
+            return {
+                k: v
+                for k, v in c.metrics.items()
+                if k == "fused" or k.startswith("battery_")
+            }
+    return {}
+
+
+def measured_node_stats(checks) -> dict[str, float]:
+    """One host's measured side-channel stats across all its checks:
+    throughput figures (``tflops``/``mfu``/``gbps``/``busbw_gbps``)
+    plus the battery timing keys — the per-node sample the telemetry
+    plane (obs/telemetry.py) folds into fleet baselines.  Shape-only
+    keys (n/iters/devices/floors) are excluded; a timing-inconclusive
+    check contributes nothing."""
+    out: dict[str, float] = {}
+    for c in checks:
+        if c.metrics.get("timing_inconclusive"):
+            continue
+        for k in ("tflops", "mfu", "gbps", "busbw_gbps"):
+            if k in c.metrics:
+                out[k] = c.metrics[k]
+    out.update(
+        {
+            k: v
+            for k, v in battery_telemetry(checks).items()
+            if k.startswith("battery_") and k != "battery_cache_hit"
+        }
+    )
+    return out
+
+
 @dataclass
 class HealthReport:
     """One host's probe outcome, as published to its node annotation."""

@@ -28,7 +28,10 @@ import jax
 
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.health.probes import run_host_probe
-from k8s_operator_libs_tpu.health.report import HealthReport
+from k8s_operator_libs_tpu.health.report import (
+    HealthReport,
+    measured_node_stats,
+)
 from k8s_operator_libs_tpu.upgrade.types import UpgradeGroup
 from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
 from k8s_operator_libs_tpu.upgrade.validation_manager import ProbeResult
@@ -77,13 +80,23 @@ class LocalDeviceProber:
             allreduce_elems=self.allreduce_elems,
             fused=self.fused,
         )
+        # Measured side-channel stats for the telemetry plane: the
+        # battery ran once in-process, so every member host gets the
+        # same sample (single-host path — controller and devices are
+        # one machine).
+        stats = measured_node_stats(checks)
+        telemetry = (
+            {n.name: dict(stats) for n in group.nodes} if stats else None
+        )
         failed = [c for c in checks if not c.ok]
         if failed:
             detail = "; ".join(f"{c.name}: {c.detail}" for c in failed)
             logger.info("group %s local probe failed: %s", group.id, detail)
-            return ProbeResult(False, detail)
+            return ProbeResult(False, detail, telemetry=telemetry)
         return ProbeResult(
-            True, f"all {len(checks)} local device checks passed"
+            True,
+            f"all {len(checks)} local device checks passed",
+            telemetry=telemetry,
         )
 
 
@@ -277,16 +290,29 @@ class NodeReportProber:
         now = time.time()
         hbm_floor = self._hbm_floor(group)
         ici_floor = self._ici_floor(group)
+        # Measured per-node telemetry collected as reports parse — kept
+        # even on a failing verdict (a slow-but-parsing host is exactly
+        # the sample the straggler baseline needs).
+        telemetry: dict[str, dict[str, float]] = {}
         for node in group.nodes:
             raw = node.annotations.get(key)
             if not raw:
                 return ProbeResult(
-                    False, f"no health report from node {node.name}"
+                    False,
+                    f"no health report from node {node.name}",
+                    telemetry=telemetry or None,
                 )
             try:
                 report = HealthReport.from_json(raw)
             except ValueError as e:
-                return ProbeResult(False, f"node {node.name}: {e}")
+                return ProbeResult(
+                    False,
+                    f"node {node.name}: {e}",
+                    telemetry=telemetry or None,
+                )
+            stats = measured_node_stats(report.checks)
+            if stats:
+                telemetry[node.name] = stats
             # Staleness reference: the gate's start time when stamped (the
             # workload may have re-locked the devices since — see
             # _check_report), else now.
@@ -296,9 +322,14 @@ class NodeReportProber:
                 report, group, required_rev, ref, hbm_floor, ici_floor
             )
             if reason is not None:
-                return ProbeResult(False, f"node {node.name}: {reason}")
+                return ProbeResult(
+                    False,
+                    f"node {node.name}: {reason}",
+                    telemetry=telemetry or None,
+                )
         return ProbeResult(
             True,
             f"all {group.size()} host report(s) healthy"
             + (f" @ revision {required_rev}" if required_rev else ""),
+            telemetry=telemetry or None,
         )

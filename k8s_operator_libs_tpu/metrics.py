@@ -37,11 +37,34 @@ class MetricsRegistry:
         self._values: dict[str, dict[tuple, float]] = defaultdict(dict)
         # name -> label key names
         self._label_keys: dict[str, tuple[str, ...]] = {}
+        # name -> "counter" | "gauge" (drives the # TYPE line)
+        self._kinds: dict[str, str] = {}
+        # every describe() call in order — lets the registry self-lint
+        # test catch a family registered twice
+        self.described: list[str] = []
 
-    def describe(self, name: str, help_text: str, *label_keys: str) -> None:
+    def describe(
+        self,
+        name: str,
+        help_text: str,
+        *label_keys: str,
+        kind: Optional[str] = None,
+    ) -> None:
+        """Register a family.  ``kind`` defaults by naming convention:
+        families ending ``_total`` are counters, everything else a
+        gauge — the registry self-lint pins that the convention and any
+        explicit override agree."""
         with self._lock:
+            self.described.append(name)
             self._help[name] = help_text
             self._label_keys[name] = tuple(label_keys)
+            self._kinds[name] = kind or (
+                "counter" if name.endswith("_total") else "gauge"
+            )
+
+    def kind(self, name: str) -> str:
+        with self._lock:
+            return self._kinds.get(name, "gauge")
 
     def _keys_for(self, name: str, labels: dict[str, str]) -> tuple:
         """Label keys for a metric; an undescribed metric adopts the keys
@@ -89,7 +112,8 @@ class MetricsRegistry:
                 full = f"{PREFIX}_{name}"
                 if name in self._help:
                     lines.append(f"# HELP {full} {self._help[name]}")
-                    lines.append(f"# TYPE {full} gauge")
+                    kind = self._kinds.get(name, "gauge")
+                    lines.append(f"# TYPE {full} {kind}")
                 keys = self._label_keys.get(name, ())
                 for label_vals, value in sorted(self._values[name].items()):
                     if keys:
@@ -524,6 +548,38 @@ class UpgradeMetrics:
             "budget-release wakeup; they re-enter on the next release "
             "or full resync",
         )
+        # Fleet health telemetry surface (obs/telemetry; absent on
+        # injected fake managers without the plane wired).
+        r.describe(
+            "node_health_score",
+            "Per-node health score (100 = at fleet baseline; 12.5 points "
+            "lost per robust-z of the worst below-baseline stat)",
+            "node",
+        )
+        r.describe(
+            "fleet_stragglers",
+            "Nodes holding a confirmed straggler verdict (sustained "
+            "below-baseline probe telemetry), per cohort",
+            "generation",
+            "pool",
+        )
+        r.describe(
+            "probe_measured",
+            "Fleet median of each measured probe statistic's latest "
+            "per-node sample",
+            "check",
+            "stat",
+        )
+        r.describe(
+            "telemetry_samples_total",
+            "Probe-battery telemetry samples ingested into per-node "
+            "histories",
+        )
+        r.describe(
+            "telemetry_drops_total",
+            "Telemetry-plane fail-open exceptions swallowed (capture, "
+            "persistence, or adoption path)",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -864,6 +920,34 @@ class UpgradeMetrics:
                     "roll_makespan_bucket_seconds", seconds, bucket=bucket
                 )
 
+    def observe_telemetry(self, manager) -> None:
+        """Publish the fleet-health telemetry surface (obs/telemetry):
+        per-node health scores, confirmed stragglers per cohort, and the
+        fleet median of each measured probe stat.  Gauges are cleared
+        first so departed nodes and cohorts don't linger.  getattr-
+        guarded: injected fake managers without the plane publish
+        nothing."""
+        plane = getattr(manager, "telemetry_plane", None)
+        if plane is None:
+            return
+        r = self.registry
+        view = plane.metrics_view()
+        r.clear("node_health_score")
+        for node, score in sorted(view["scores"].items()):
+            r.set("node_health_score", score, node=node)
+        r.clear("fleet_stragglers")
+        for (generation, pool), count in sorted(
+            view["stragglers"].items()
+        ):
+            r.set(
+                "fleet_stragglers", count, generation=generation, pool=pool
+            )
+        r.clear("probe_measured")
+        for (check, stat), value in sorted(view["measured"].items()):
+            r.set("probe_measured", value, check=check, stat=stat)
+        r.set("telemetry_samples_total", view["samples_total"])
+        r.set("telemetry_drops_total", view["drops"])
+
     def observe_sharded(self, sharded, report=None) -> None:
         """Publish the sharded-reconcile surface.  Called with a
         TickReport after each dirty tick, and without one after a full
@@ -967,22 +1051,34 @@ class SliceUpgradeTimer:
 
 
 class MetricsServer:
-    """Serve the registry at /metrics on a stdlib HTTP thread."""
+    """Serve the registry at /metrics (plus a /healthz liveness probe)
+    on a stdlib HTTP thread.  Binds loopback by default — exposing the
+    scrape endpoint beyond the pod is an explicit deployment decision
+    (``--metrics-bind-addr 0.0.0.0``), not a side effect."""
 
-    def __init__(self, registry: MetricsRegistry, port: int = 0) -> None:
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        bind_addr: str = "127.0.0.1",
+    ) -> None:
         registry_ref = registry
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path = self.path.rstrip("/")
+                if path == "/healthz":
+                    body = b"ok\n"
+                    content_type = "text/plain"
+                elif path in ("", "/metrics"):
+                    body = registry_ref.render().encode()
+                    content_type = "text/plain; version=0.0.4"
+                else:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = registry_ref.render().encode()
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -990,7 +1086,8 @@ class MetricsServer:
             def log_message(self, *args):
                 pass
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._server = ThreadingHTTPServer((bind_addr, port), Handler)
+        self.bind_addr = bind_addr
         self.port = self._server.server_port
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
@@ -998,7 +1095,11 @@ class MetricsServer:
 
     def start(self) -> None:
         self._thread.start()
-        logger.info("metrics listening on :%d/metrics", self.port)
+        logger.info(
+            "metrics listening on %s:%d/metrics (liveness at /healthz)",
+            self.bind_addr,
+            self.port,
+        )
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -1,6 +1,7 @@
-"""Roll tracing & flight recorder (observability layer).
+"""Roll tracing, flight recorder & fleet health telemetry (observability
+layer).
 
-Three read-mostly, fail-open parts:
+Four read-mostly, fail-open parts:
 
 - :mod:`trace` — span model + recorder: every fleet roll becomes one
   causal span tree (roll → pool → wave → slice-group → node → phase,
@@ -16,10 +17,18 @@ Three read-mostly, fail-open parts:
   window-hold vs quarantine vs API-retry, compare per-phase actuals
   against the PhaseClocks projection, and publish the top drift
   contributors (CR ``makespanBreakdown``, metrics, ``make trace``).
+- :mod:`telemetry` + :mod:`baseline` — fleet health: every probe
+  battery's measured side channel (TFLOPs, HBM GB/s, ICI bus BW,
+  execute time) lands in a bounded per-node ring riding the combined
+  transition patch (zero extra writes, re-adopted across restarts),
+  folds into per-(generation, pool) median+MAD baselines, and yields
+  health scores plus sustained-deviation straggler verdicts —
+  observe-only unless ``healthGate.quarantineStragglers`` opts in.
 
-Tracing is observe-only by contract: every entry point fails open, so
-a recorder failure can never block a state transition (drops are
-counted into ``trace_drops_total`` instead).  See docs/observability.md.
+Observability is observe-only by contract: every entry point fails
+open, so a recorder or telemetry failure can never block a state
+transition (drops are counted into ``trace_drops_total`` /
+``telemetry_drops_total`` instead).  See docs/observability.md.
 """
 
 from k8s_operator_libs_tpu.obs.trace import (  # noqa: F401
@@ -32,6 +41,17 @@ from k8s_operator_libs_tpu.obs.trace import (  # noqa: F401
 from k8s_operator_libs_tpu.obs.flightrec import (  # noqa: F401
     FlightRecorder,
     redact,
+)
+from k8s_operator_libs_tpu.obs.baseline import (  # noqa: F401
+    BaselineStat,
+    compute_baselines,
+    health_score,
+    node_badness,
+)
+from k8s_operator_libs_tpu.obs.telemetry import (  # noqa: F401
+    TelemetryPlane,
+    format_ring,
+    parse_ring,
 )
 from k8s_operator_libs_tpu.obs.critical import (  # noqa: F401
     Attribution,

@@ -79,6 +79,11 @@ class PhaseClockTracker:
         # node name -> pool name ("" = pool-less); refreshed each full
         # pass by the controller from the policy's pool selectors.
         self._node_pool: dict[str, str] = {}
+        # Confirmed health stragglers (fed by the telemetry plane each
+        # pass): the status block annotates which pools' measured
+        # clocks — and therefore the planner's ETA — are inflated by a
+        # slow node rather than by the phase itself.
+        self._straggler_nodes: set[str] = set()
 
     # -- wiring --------------------------------------------------------
 
@@ -86,6 +91,12 @@ class PhaseClockTracker:
         """Refresh the node→pool attribution map (full pass scope)."""
         with self._lock:
             self._node_pool.update(node_pool)
+
+    def set_straggler_nodes(self, names: Iterable[str]) -> None:
+        """Replace the confirmed-straggler set (telemetry plane feed,
+        once per pass; a cleared verdict drops the annotation)."""
+        with self._lock:
+            self._straggler_nodes = {str(n) for n in names}
 
     # -- observation ---------------------------------------------------
 
@@ -164,14 +175,27 @@ class PhaseClockTracker:
     # -- durability (CR status via the write plane) --------------------
 
     def to_status(self) -> dict:
-        """``{pool: {camelPhase: seconds}}`` for the CR status block."""
+        """``{pool: {camelPhase: seconds}}`` for the CR status block.
+
+        Pools containing a confirmed health straggler additionally carry
+        ``stragglersInflatingEta`` (the slow nodes by name), so an
+        operator reading a pool's inflated measured clocks can tell
+        "this pool's ETA is inflated by node X" apart from "this phase
+        is slow fleet-wide".  ``load_status`` ignores the key on
+        adoption — verdicts re-derive from the telemetry rings, never
+        from the status echo."""
         with self._lock:
-            out: dict[str, dict[str, float]] = {}
+            out: dict[str, dict] = {}
             for (pool, phase), val in sorted(self._ewma.items()):
                 name = pool or _DEFAULT_POOL_KEY
                 out.setdefault(name, {})[_PHASE_TO_CAMEL[phase]] = round(
                     val, 3
                 )
+            for node in sorted(self._straggler_nodes):
+                name = self._node_pool.get(node, "") or _DEFAULT_POOL_KEY
+                out.setdefault(name, {}).setdefault(
+                    "stragglersInflatingEta", []
+                ).append(node)
             return out
 
     def load_status(self, data: Optional[dict]) -> None:

@@ -366,6 +366,70 @@ def plan_health(metrics_url: str, fetch=None) -> Optional[dict]:
     return out if set(out) - _PLAN_ALWAYS_ON_KEYS else None
 
 
+def telemetry_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Fleet health from the controller's /metrics: per-node health
+    scores folded to a distribution, confirmed stragglers per
+    (generation, pool) cohort, and the telemetry plane's own counters.
+
+    Returns None when the family is absent (telemetry disabled or no
+    batteries observed yet), an ``{"error": ...}`` dict when the
+    endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    scores: dict[str, float] = {}
+    stragglers: list[dict] = []
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "node_health_score":
+            node = labels.split('node="', 1)
+            if len(node) == 2:
+                scores[node[1].split('"', 1)[0]] = val
+        elif short == "fleet_stragglers" and val:
+            gen = labels.split('generation="', 1)
+            pool = labels.split('pool="', 1)
+            stragglers.append(
+                {
+                    "generation": (
+                        gen[1].split('"', 1)[0] if len(gen) == 2 else ""
+                    ),
+                    "pool": (
+                        pool[1].split('"', 1)[0] if len(pool) == 2 else ""
+                    ),
+                    "count": int(val),
+                }
+            )
+        elif short == "telemetry_samples_total":
+            out["samples"] = int(val)
+        elif short == "telemetry_drops_total":
+            out["drops"] = int(val)
+    if scores:
+        out["scoredNodes"] = len(scores)
+        out["meanScore"] = round(sum(scores.values()) / len(scores), 1)
+        worst = min(scores, key=scores.get)
+        out["worstNode"] = worst
+        out["worstScore"] = scores[worst]
+    if stragglers:
+        out["stragglers"] = sorted(
+            stragglers, key=lambda s: (s["generation"], s["pool"])
+        )
+    return out if (scores or stragglers or out.get("samples")) else None
+
+
 def gather(
     client: KubeClient,
     namespace: str,
@@ -438,6 +502,15 @@ def gather(
                 policy_section["makespanBreakdown"] = cr_status[
                     "makespanBreakdown"
                 ]
+            # Fleet health telemetry (obs/telemetry.py): cohort
+            # baselines + confirmed stragglers as the controller last
+            # published them.
+            if cr_status.get("healthSummary"):
+                policy_section["healthSummary"] = cr_status[
+                    "healthSummary"
+                ]
+            if cr_status.get("stragglers"):
+                policy_section["stragglers"] = cr_status["stragglers"]
             try:
                 policy = TPUUpgradePolicySpec.from_dict(cr.get("spec") or {})
             except (ValueError, TypeError):
@@ -619,6 +692,9 @@ def gather(
         plan = plan_health(metrics_url, fetch=metrics_fetch)
         if plan is not None:
             out["plan"] = plan
+        health = telemetry_health(metrics_url, fetch=metrics_fetch)
+        if health is not None:
+            out["fleetHealth"] = health
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -926,6 +1002,67 @@ def render(status: dict) -> str:
             ).get("planTraceId")
             if trace_id:
                 lines.append(f"  trace: {trace_id}")
+    health = status.get("fleetHealth")
+    cr_health = (status.get("policy") or {}).get("healthSummary")
+    cr_stragglers = (status.get("policy") or {}).get("stragglers")
+    # The durable CR-status copy backs the section when the live
+    # metrics endpoint was not consulted.
+    if health is None and (cr_health or cr_stragglers):
+        health = {}
+        if cr_health:
+            health["scoredNodes"] = cr_health.get("scoredNodes", 0)
+            health["meanScore"] = cr_health.get("meanScore", 0.0)
+            health["cohorts"] = cr_health.get("cohorts") or []
+        if cr_stragglers:
+            health["confirmed"] = cr_stragglers
+    if health is not None:
+        lines.append("")
+        if "error" in health:
+            lines.append(f"fleet health: {health['error']}")
+        else:
+            head = (
+                f"fleet health: {int(health.get('scoredNodes', 0))} "
+                f"node(s) scored, mean {health.get('meanScore', 0.0):.1f}"
+            )
+            if health.get("worstNode"):
+                head += (
+                    f" (worst {health['worstNode']} at "
+                    f"{health.get('worstScore', 0.0):.1f})"
+                )
+            if "samples" in health:
+                head += (
+                    f" | {int(health['samples'])} sample(s), "
+                    f"{int(health.get('drops', 0))} drop(s)"
+                )
+            lines.append(head)
+            # Per-generation cohort baselines (CR path only: the
+            # metric surface carries medians per check, not cohorts).
+            for cohort in health.get("cohorts") or []:
+                stats = ", ".join(
+                    f"{stat} {b.get('median', 0.0):g}±{b.get('mad', 0.0):g}"
+                    for stat, b in sorted(
+                        (cohort.get("baseline") or {}).items()
+                    )
+                )
+                lines.append(
+                    f"  {cohort.get('generation', '') or '?'}/"
+                    f"{cohort.get('pool', '') or 'default'}: "
+                    f"{int(cohort.get('nodes', 0))} node(s)"
+                    + (f" | {stats}" if stats else "")
+                )
+            for s in health.get("stragglers") or []:
+                lines.append(
+                    f"  STRAGGLERS {s.get('generation', '') or '?'}/"
+                    f"{s.get('pool', '') or 'default'}: "
+                    f"{int(s.get('count', 0))}"
+                )
+            for v in health.get("confirmed") or []:
+                lines.append(
+                    f"  STRAGGLER {v.get('node', '')}: score "
+                    f"{v.get('score', 0.0)}, z {v.get('z', 0.0)} on "
+                    f"{v.get('worstStat', '')} over "
+                    f"{int(v.get('streak', 0))} consecutive batteries"
+                )
     breakdown = (status.get("policy") or {}).get("makespanBreakdown")
     if breakdown:
         from k8s_operator_libs_tpu.obs.critical import render_breakdown

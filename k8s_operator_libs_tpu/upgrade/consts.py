@@ -261,6 +261,14 @@ UPGRADE_QUARANTINE_CYCLE_COUNT_ANNOTATION_KEY_FMT = (
 UPGRADE_TRACE_ANNOTATION_KEY_FMT = (
     "{domain}/{driver}-driver-upgrade-trace"
 )
+# Durable per-node telemetry history: bounded JSON ring of the last K
+# measured probe samples (obs/telemetry.py), riding the SAME combined
+# metadata patch as the state label — zero extra write verbs.  Unlike the
+# trace anchor above this one is LONGITUDINAL: it is never cleared on
+# terminal states, so fleet baselines survive across rolls and restarts.
+UPGRADE_TELEMETRY_HISTORY_ANNOTATION_KEY_FMT = (
+    "{domain}/{driver}-driver-upgrade-telemetry-history"
+)
 
 # --- elastic roll coordination ---------------------------------------------
 # The annotation-mediated negotiation protocol between the controller and

@@ -109,10 +109,13 @@ class NodeUpgradeStateProvider:
         # are exception-isolated from each other and a failing observer
         # must never block a transition.
         self._transition_observers: list = []
-        # Durable trace anchor (obs/trace.py): when set, returns an
-        # annotation patch merged into the SAME intent as the state
-        # label — crash durability that costs zero extra API writes.
-        self.transition_annotation_source = None
+        # Durable annotation sources (obs/trace.py anchor, obs/
+        # telemetry.py history ring): each returns an annotation patch
+        # merged into the SAME intent as the state label — crash
+        # durability that costs zero extra API writes.  Multicast like
+        # the transition observers above; sources are exception-isolated
+        # from each other.
+        self._transition_annotation_sources: list = []
 
     # -- transition observers ------------------------------------------------
 
@@ -153,20 +156,51 @@ class NodeUpgradeStateProvider:
             except Exception:
                 logger.exception("transition observer failed; continuing")
 
-    def _trace_annotations(self, node, new_state) -> dict:
-        """Durable trace-anchor patch riding the state-label intent
-        (fail-open: tracing must never block or dirty a transition)."""
-        source = self.transition_annotation_source
-        if source is None:
-            return {}
+    @property
+    def transition_annotation_source(self):
+        """Back-compat single-slot view (same contract as
+        ``transition_observer``): the first registered source, or None.
+        Assigning replaces the whole list."""
+        return (
+            self._transition_annotation_sources[0]
+            if self._transition_annotation_sources
+            else None
+        )
+
+    @transition_annotation_source.setter
+    def transition_annotation_source(self, fn) -> None:
+        self._transition_annotation_sources = [] if fn is None else [fn]
+
+    def add_transition_annotation_source(self, fn) -> None:
+        """Register an additional durable-annotation source."""
+        if fn is not None and fn not in self._transition_annotation_sources:
+            self._transition_annotation_sources.append(fn)
+
+    def remove_transition_annotation_source(self, fn) -> None:
         try:
-            extra = source(node, new_state)
-        except Exception:
-            logger.exception("transition annotation source failed")
+            self._transition_annotation_sources.remove(fn)
+        except ValueError:
+            pass
+
+    def _trace_annotations(self, node, new_state) -> dict:
+        """Durable annotation patches riding the state-label intent
+        (fail-open: observability must never block or dirty a
+        transition).  Multicast: each source contributes its keys; a
+        raising source is isolated and contributes nothing."""
+        if not self._transition_annotation_sources:
             return {}
+        extra: dict = {}
+        for source in list(self._transition_annotation_sources):
+            try:
+                patch = source(node, new_state)
+            except Exception:
+                logger.exception("transition annotation source failed")
+                continue
+            if patch:
+                extra.update(patch)
         if not extra:
             return {}
-        # Suppress no-op anchor writes against the cached object so an
+        # Suppress no-op writes against the cached object so an
         # idempotent re-drive stays write-free.
         out = {}
         for key, value in extra.items():

@@ -133,6 +133,8 @@ class ClusterUpgradeStateManager:
         drain_poll_interval_s: Optional[float] = None,
         trace_recorder=None,
         enable_tracing: bool = True,
+        telemetry_plane=None,
+        enable_telemetry: bool = True,
     ) -> None:
         self.client = client
         self.keys = keys or UpgradeKeys()
@@ -374,6 +376,38 @@ class ClusterUpgradeStateManager:
                 )
             except AttributeError:
                 pass
+        # Fleet health telemetry plane (obs/telemetry.py): every probe
+        # battery's measured stats land in a durable per-node ring that
+        # rides the combined transition patch, folded into per-
+        # (generation, pool) baselines and straggler verdicts.  Observe
+        # -only and fail-open, same contract as the trace recorder;
+        # pass enable_telemetry=False to remove even the hooks.
+        self.telemetry_plane = None
+        if enable_telemetry:
+            # Deferred import, same cycle-avoidance as obs.trace above.
+            from k8s_operator_libs_tpu.obs.telemetry import TelemetryPlane
+
+            self.telemetry_plane = telemetry_plane or TelemetryPlane()
+        if self.telemetry_plane is not None:
+            plane = self.telemetry_plane
+            # Durable history ring rides the state-label intents,
+            # multicast next to the trace anchor.
+            plane.annotation_key = self.keys.telemetry_history_annotation
+            add_source = getattr(
+                self.provider, "add_transition_annotation_source", None
+            )
+            if add_source is not None:  # injected fakes may lack it
+                add_source(plane.annotation_source)
+            # Capture hook: every probe verdict's measured stats.
+            if getattr(
+                self.validation_manager, "telemetry_sink", None
+            ) is None:
+                try:
+                    self.validation_manager.telemetry_sink = (
+                        plane.observe_validation
+                    )
+                except AttributeError:
+                    pass  # injected fakes may refuse the attribute
         # Flight recorder (obs/flightrec.py): wired by the controller
         # via set_flight_recorder(); None means "no black box".
         self.flight_recorder = None
@@ -391,6 +425,10 @@ class ClusterUpgradeStateManager:
         if self.trace_recorder is not None:
             self.trace_recorder.flight_recorder = recorder
             recorder.snapshot_providers["trace"] = self.trace_recorder.export
+        if self.telemetry_plane is not None:
+            recorder.snapshot_providers["telemetry"] = (
+                self.telemetry_plane.export
+            )
         recorder.snapshot_providers["ledger"] = self._ledger_snapshot_dict
         try:
             self.stuck_detector.flight_recorder = recorder
@@ -558,8 +596,22 @@ class ClusterUpgradeStateManager:
             "rollbacks": 0,
             "probes": 0,
             "traces": 0,
+            "telemetry": 0,
         }
         now_epoch = int(time.time())
+
+        # (a0) Telemetry history: re-seed every node's measured-sample
+        # ring from its durable annotation — baselines re-derive from
+        # the rings alone, so a restarted controller scores the fleet
+        # from the same longitudinal record the crashed one had (the
+        # PR 3 durable-clock idiom applied to health history).  ALL
+        # nodes, not only in-flight ones: history is longitudinal.
+        plane = self.telemetry_plane
+        if plane is not None:
+            for members in state.node_states.values():
+                for nus in members:
+                    if plane.adopt_node(nus.node):
+                        summary["telemetry"] += 1
 
         # (a) Seed the shared escalation counters from persisted rungs:
         # one record per node, counting every rung up to the committed
@@ -666,13 +718,15 @@ class ClusterUpgradeStateManager:
         logger.info(
             "re-adoption (%s): %d in-flight group(s), %d persisted "
             "ladder rung(s), %d pending rollback(s), %d probe "
-            "backoff(s), %d trace span(s) re-opened",
+            "backoff(s), %d trace span(s) re-opened, %d telemetry "
+            "ring(s) re-seeded",
             stamp,
             summary["groups"],
             summary["rungs"],
             summary["rollbacks"],
             summary["probes"],
             summary["traces"],
+            summary["telemetry"],
         )
         if summary["groups"] or summary["traces"]:
             # Crash-adoption is a black-box trigger: capture what the
@@ -2289,6 +2343,28 @@ class ClusterUpgradeStateManager:
             return f"node(s) not ready: {', '.join(not_ready)}"
         return None
 
+    def _straggler_fault_reason(
+        self, group: UpgradeGroup, policy
+    ) -> Optional[str]:
+        """Opt-in: a confirmed health straggler is treated like a
+        hardware fault for quarantine purposes.  Off by default
+        (``health.quarantineStragglers``) — the telemetry plane is
+        observe-only unless the operator explicitly routes verdicts
+        into the quarantine path.  Dwell/cycle-cap semantics are the
+        quarantine machinery's, unchanged."""
+        plane = self.telemetry_plane
+        if plane is None or not isinstance(policy, TPUUpgradePolicySpec):
+            return None
+        gate = policy.health_gate
+        if gate is None or not getattr(gate, "quarantine_stragglers", False):
+            return None
+        confirmed = sorted(
+            n.name for n in group.nodes if plane.is_straggler(n.name)
+        )
+        if not confirmed:
+            return None
+        return "confirmed health straggler(s): " + ", ".join(confirmed)
+
     def _move_group_bucket(
         self,
         state: ClusterUpgradeState,
@@ -2356,6 +2432,10 @@ class ClusterUpgradeStateManager:
             for st in QUARANTINABLE_STATES:
                 for group in list(state.groups_in(st)):
                     reason = self._group_fault_reason(group)
+                    straggler_park = False
+                    if reason is None:
+                        reason = self._straggler_fault_reason(group, policy)
+                        straggler_park = reason is not None
                     if reason is None:
                         continue
                     logger.warning(
@@ -2411,6 +2491,12 @@ class ClusterUpgradeStateManager:
                     self.quarantine_reasons[group.id] = (
                         f"quarantined: {reason}"
                     )
+                    if straggler_park and self.telemetry_plane is not None:
+                        # Consume the verdict on park: the streak resets,
+                        # so a rejoined slice needs M fresh slow batteries
+                        # to re-confirm — no park loop on a stale verdict.
+                        for node in group.nodes:
+                            self.telemetry_plane.consume_straggler(node.name)
                     if self.budget_ledger is not None:
                         # A quarantined group holds no budget — same
                         # contract as the state-local counters, enforced

@@ -208,6 +208,10 @@ class UpgradeKeys:
         return self._fmt(C.UPGRADE_TRACE_ANNOTATION_KEY_FMT)
 
     @property
+    def telemetry_history_annotation(self) -> str:
+        return self._fmt(C.UPGRADE_TELEMETRY_HISTORY_ANNOTATION_KEY_FMT)
+
+    @property
     def slice_id_label(self) -> str:
         return self._fmt(C.SLICE_ID_LABEL_KEY_FMT)
 

@@ -53,6 +53,12 @@ VALIDATION_TIMEOUT_SECONDS_DEFAULT = 600
 class ProbeResult:
     healthy: bool
     detail: str = ""
+    # Measured side-channel telemetry per node ({node name: {stat:
+    # value}}), populated by probers that have real numbers (the report
+    # aggregator, the local device battery).  Observability only: the
+    # verdict above is the gate; telemetry rides along to the fleet
+    # telemetry plane (obs/telemetry.py) and never affects healthy.
+    telemetry: Optional[dict] = None
 
 
 class SliceProber(Protocol):
@@ -172,6 +178,12 @@ class ValidationManager:
         # Roll tracing (obs/trace.py): fanned in by the state
         # manager; feeds eviction-rung entries into the span tree.
         self.trace_recorder = None
+        # Fleet telemetry capture (obs/telemetry.py): wired by the state
+        # manager to TelemetryPlane.observe_validation.  Called with
+        # (group, result) for EVERY probe verdict — healthy or not, sync
+        # or async — exactly once per battery.  Fail-open: a raising
+        # sink never affects the gate.
+        self.telemetry_sink = None
         # -- async (pipelined) probing ----------------------------------
         # A prober that marks itself ``async_probe = True`` (the fused
         # device battery — real XLA work, up to seconds even warm) runs
@@ -331,6 +343,19 @@ class ValidationManager:
                 return False
         else:
             result = self.prober.probe(group)
+        if self.telemetry_sink is not None:
+            # One battery = one capture, whatever the verdict (a slow
+            # node that still clears the floor is exactly the sample the
+            # straggler baseline needs).  Async verdicts are consumed
+            # once, so this also fires once per battery on that path.
+            try:
+                self.telemetry_sink(group, result)
+            except Exception:  # noqa: BLE001 — observability is fail-open
+                logger.debug(
+                    "telemetry sink failed for group %s",
+                    group.id,
+                    exc_info=True,
+                )
         if not result.healthy:
             logger.info("group %s validation pending: %s", group.id, result.detail)
             self.last_rejection[group.id] = result.detail

@@ -1742,3 +1742,97 @@ def test_mixed_generation_pools_roll_through_preemption_chaos():
     # Every transition the roll took is a documented edge.
     undocumented = recorder.observed - EDGES
     assert not undocumented, f"undocumented transitions: {undocumented}"
+
+
+def test_telemetry_ring_survives_crash_between_batteries():
+    """Telemetry crash point (fleet health durability): battery 1 rides
+    the combined transition patch onto the durable ring, the controller
+    dies between batteries, and the successor must resume the SAME ring
+    from annotations alone — no duplicated samples, no sequence reset —
+    then battery 2 extends it through the rest of the roll."""
+    from k8s_operator_libs_tpu.obs.telemetry import parse_ring
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    nodes = _upgrade_scenario(store, keys)  # 2 slices x 2 hosts
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("100%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+    crasher = ControllerCrasher(store, keys, policy)
+    ring_key = keys.telemetry_history_annotation
+
+    def durable_rings():
+        return {
+            n.name: parse_ring(
+                store.get_node(n.name, cached=False).annotations.get(
+                    ring_key
+                )
+            )
+            for n in nodes
+        }
+
+    # Battery 1: every node reports once (in memory, rings dirty).
+    for n in nodes:
+        crasher.mgr.telemetry_plane.ingest(
+            n.name,
+            {"tflops": 240.0, "gbps": 980.0},
+            generation="tpu-v5p-slice",
+        )
+    # Tick until every ring has ridden a transition patch to the API —
+    # the history must cost zero dedicated writes.
+    for _ in range(40):
+        crasher.tick()
+        if all(durable_rings().values()):
+            break
+    before = durable_rings()
+    assert all(len(ring) == 1 for ring in before.values()), before
+
+    # Crash between batteries: the successor starts with empty memory.
+    crasher.kill("between-batteries")
+    plane = crasher.mgr.telemetry_plane
+    assert plane._rings == {}
+    crasher.tick()  # first successor tick re-adopts durable state
+    assert crasher.adopt_summaries[-1]["telemetry"] == len(nodes)
+    for n in nodes:
+        assert plane._rings[n.name] == before[n.name], (
+            "adopted ring diverged from the durable annotation"
+        )
+    # Baselines re-derive from the adopted rings ALONE: same-pool
+    # attribution arrives with the pass, the history needs no other
+    # source.
+    plane.seed_pools({n.name: "pool" for n in nodes})
+    for n in nodes:
+        plane._node_generation[n.name] = "tpu-v5p-slice"
+    plane.recompute()
+    assert plane._baselines, "baselines did not re-seed from annotations"
+
+    # Battery 2: the successor continues the sequence (seq 2, not 1).
+    for n in nodes:
+        plane.ingest(
+            n.name,
+            {"tflops": 239.0, "gbps": 978.0},
+            generation="tpu-v5p-slice",
+        )
+    for _ in range(200):
+        crasher.tick()
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+    else:
+        pytest.fail(f"roll never converged after the crash: {states}")
+    for name, ring in durable_rings().items():
+        assert [s[0] for s in ring] == [1, 2], (
+            f"{name}: ring did not extend cleanly across the crash "
+            f"(seqs {[s[0] for s in ring]})"
+        )
+        assert ring[0] == before[name][0], (
+            f"{name}: battery-1 sample mutated across the crash"
+        )

@@ -1,0 +1,577 @@
+"""Fleet health telemetry tier: baseline math, the durable per-node
+ring, straggler verdicts, fused/unfused telemetry parity, the metrics
+registry self-lint, and the health surfaces (metrics families, status
+CLI, phase-clock annotation).
+
+The telemetry plane is observe-only by contract — the tests also pin
+the fail-open side (a corrupt ring annotation reads as empty history,
+a bad sink can never fail a probe gate) and the durability side (the
+ring rides the combined transition patch and survives adoption without
+duplication).  See docs/observability.md "Fleet health telemetry"."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_operator_libs_tpu.metrics import (
+    PREFIX,
+    MetricsRegistry,
+    MetricsServer,
+    UpgradeMetrics,
+)
+from k8s_operator_libs_tpu.obs.baseline import (
+    DEFAULT_MIN_COHORT,
+    STAT_ORIENTATION,
+    BaselineStat,
+    compute_baselines,
+    health_score,
+    mad,
+    median,
+    node_badness,
+)
+from k8s_operator_libs_tpu.obs.telemetry import (
+    TelemetryPlane,
+    format_ring,
+    parse_ring,
+)
+from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+from tests.fixtures import make_node
+
+KEYS = UpgradeKeys()
+
+
+def _plane(**kwargs) -> TelemetryPlane:
+    kwargs.setdefault("epoch_clock", lambda: 1000.0)
+    plane = TelemetryPlane(**kwargs)
+    plane.annotation_key = KEYS.telemetry_history_annotation
+    return plane
+
+
+def _seed_cohort(plane, batteries=1, count=8, slow=(), factor=0.75):
+    """Ingest ``batteries`` rounds for a ``count``-node cohort; nodes in
+    ``slow`` run at ``factor`` of the cohort's nominal throughput."""
+    for b in range(batteries):
+        for i in range(count):
+            scale = 1.0 + 0.004 * ((i * 7 + b * 3) % 5 - 2)
+            if f"n{i}" in slow:
+                scale *= factor
+            plane.ingest(
+                f"n{i}",
+                {"tflops": 240.0 * scale, "battery_execute_ms": 40.0 / scale},
+                generation="tpu-v5p-slice",
+                pool="pool-a",
+            )
+    plane.recompute()
+
+
+# --- baseline math ---------------------------------------------------------
+
+
+def test_median_and_mad():
+    assert median([3.0, 1.0, 2.0]) == 2.0
+    assert median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    assert mad([1.0, 2.0, 3.0]) == 1.0
+    with pytest.raises(ValueError):
+        median([])
+
+
+def test_zscore_is_robust_and_defined_at_zero_mad():
+    base = BaselineStat(median=100.0, mad=2.0, count=8)
+    assert base.zscore(100.0) == 0.0
+    # 0.6745 * (90 - 100) / 2 = -3.37...
+    assert base.zscore(90.0) == pytest.approx(-3.3725)
+    # Identical cohort: z is exactly 0 at the median, huge off it.
+    flat = BaselineStat(median=100.0, mad=0.0, count=8)
+    assert flat.zscore(100.0) == 0.0
+    assert abs(flat.zscore(75.0)) > 1e5
+
+
+def test_min_cohort_guard():
+    stats = {f"n{i}": {"tflops": 100.0 + i} for i in range(4)}
+    cohort = {n: ("v5p", "a") for n in stats}
+    assert compute_baselines(stats, cohort, min_cohort=5) == {}
+    out = compute_baselines(stats, cohort, min_cohort=4)
+    assert out[("v5p", "a")]["tflops"].count == 4
+    # Nodes missing from the cohort map contribute nothing.
+    assert compute_baselines(stats, {}, min_cohort=1) == {}
+
+
+def test_badness_orientation():
+    baseline = {
+        "tflops": BaselineStat(median=100.0, mad=1.0, count=8),
+        "battery_execute_ms": BaselineStat(median=40.0, mad=1.0, count=8),
+        "mystery_stat": BaselineStat(median=5.0, mad=1.0, count=8),
+    }
+    # Low throughput is bad, high execute time is bad.
+    worst, per = node_badness(
+        {"tflops": 90.0, "battery_execute_ms": 50.0, "mystery_stat": 999.0},
+        baseline,
+    )
+    assert per["tflops"] > 3.0
+    assert per["battery_execute_ms"] > 3.0
+    # An unmapped stat can never feed a verdict.
+    assert "mystery_stat" not in per
+    assert worst == max(per.values())
+    # Better-than-baseline orients negative (never flags).
+    worst_good, per_good = node_badness({"tflops": 110.0}, baseline)
+    assert per_good["tflops"] < 0.0
+    assert worst_good < 0.0
+
+
+def test_health_score_scale():
+    assert health_score(0.0) == 100.0
+    assert health_score(-5.0) == 100.0  # better than baseline caps at 100
+    assert health_score(3.0) == 62.5  # the default threshold's score
+    assert health_score(100.0) == 0.0
+
+
+# --- ring wire format ------------------------------------------------------
+
+
+def test_ring_roundtrip():
+    samples = [
+        (1, 1000.0, {"tflops": 239.5, "gbps": 980.1}),
+        (2, 1060.5, {"tflops": 240.25}),
+    ]
+    raw = format_ring(samples)
+    assert json.loads(raw)["v"] == 1
+    assert parse_ring(raw) == samples
+
+
+def test_parse_ring_fails_open_on_garbage():
+    assert parse_ring(None) == []
+    assert parse_ring("") == []
+    assert parse_ring("not json") == []
+    assert parse_ring('{"v":1}') == []
+    assert parse_ring('{"v":1,"s":[["x"]]}') == []
+    assert parse_ring(12345) == []
+
+
+# --- the plane: capture, durability, verdicts ------------------------------
+
+
+def test_ring_is_bounded_and_sequenced():
+    plane = _plane(history_len=3)
+    for i in range(5):
+        plane.ingest("n0", {"tflops": 240.0 + i})
+    ring = plane._rings["n0"]
+    assert [s[0] for s in ring] == [3, 4, 5]  # oldest two evicted
+    assert ring[-1][2]["tflops"] == 244.0
+
+
+def test_annotation_source_rides_once_per_dirty_ring():
+    plane = _plane()
+    node = make_node("n0")
+    assert plane.annotation_source(node, "cordon-required") == {}
+    plane.ingest("n0", {"tflops": 240.0})
+    patch = plane.annotation_source(node, "cordon-required")
+    assert parse_ring(patch[KEYS.telemetry_history_annotation])
+    # Dirty cleared: the next transition stages nothing extra.
+    assert plane.annotation_source(node, "drain-required") == {}
+    # Without a configured key the plane stays in-memory only.
+    bare = TelemetryPlane()
+    bare.ingest("n0", {"tflops": 240.0})
+    assert bare.annotation_source(node, "cordon-required") == {}
+
+
+def test_adopt_node_merges_by_seq_without_duplicates():
+    plane = _plane()
+    plane.ingest("n0", {"tflops": 240.0})
+    plane.ingest("n0", {"tflops": 241.0})
+    durable = format_ring(plane._rings["n0"])
+    fresh = _plane()
+    node = make_node(
+        "n0", annotations={KEYS.telemetry_history_annotation: durable}
+    )
+    assert fresh.adopt_node(node)
+    # Second adoption (another reconcile pass) must not duplicate.
+    assert fresh.adopt_node(node)
+    assert [s[0] for s in fresh._rings["n0"]] == [1, 2]
+    # The next ingest continues the sequence, never reuses it.
+    fresh.ingest("n0", {"tflops": 242.0})
+    assert [s[0] for s in fresh._rings["n0"]] == [1, 2, 3]
+    # A node with no (or corrupt) history adopts nothing, fail-open.
+    assert not fresh.adopt_node(make_node("n1"))
+    assert not fresh.adopt_node(
+        make_node(
+            "n2", annotations={KEYS.telemetry_history_annotation: "junk"}
+        )
+    )
+
+
+def test_straggler_requires_consecutive_batteries():
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=2, slow={"n0"})
+    assert not plane.is_straggler("n0")  # two slow batteries: not yet
+    _seed_cohort(plane, batteries=1, slow={"n0"})
+    assert plane.is_straggler("n0")
+    verdict = {s["node"]: s for s in plane.to_status()["stragglers"]}["n0"]
+    assert verdict["generation"] == "tpu-v5p-slice"
+    assert verdict["pool"] == "pool-a"
+    assert verdict["streak"] == 3
+    assert verdict["z"] > 3.0
+    assert verdict["worstStat"] in STAT_ORIENTATION
+    # Nobody else flagged: jitter alone must never confirm.
+    assert set(
+        s["node"] for s in plane.to_status()["stragglers"]
+    ) == {"n0"}
+
+
+def test_one_good_battery_resets_the_streak():
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=2, slow={"n0"})
+    _seed_cohort(plane, batteries=1)  # n0 recovers for one battery
+    _seed_cohort(plane, batteries=2, slow={"n0"})
+    assert not plane.is_straggler("n0")  # streak restarted at the reset
+
+
+def test_small_cohort_never_flags():
+    plane = _plane(confirm_batteries=1, min_cohort=DEFAULT_MIN_COHORT)
+    _seed_cohort(plane, batteries=3, count=3, slow={"n0"})
+    assert plane.to_status() == {}
+    assert not plane.is_straggler("n0")
+
+
+def test_consume_straggler_requires_fresh_confirmation():
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=3, slow={"n0"})
+    assert plane.consume_straggler("n0")
+    assert not plane.is_straggler("n0")
+    # One more slow battery is not enough to re-confirm ...
+    _seed_cohort(plane, batteries=1, slow={"n0"})
+    assert not plane.is_straggler("n0")
+    # ... but confirm_batteries fresh ones are.
+    _seed_cohort(plane, batteries=2, slow={"n0"})
+    assert plane.is_straggler("n0")
+
+
+def test_new_confirmations_fire_once():
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=3, slow={"n0"})
+    fresh = plane.new_confirmations()
+    assert [v["node"] for v in fresh] == ["n0"]
+    assert plane.new_confirmations() == []  # event dedup
+    plane.recompute()
+    assert plane.new_confirmations() == []  # still confirmed, not fresh
+
+
+def test_verdicts_survive_adoption_from_annotations_alone():
+    """A restarted controller must rebuild the SAME streak from the
+    durable rings — the crashed incarnation's in-memory state is gone."""
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=3, slow={"n0"})
+    assert plane.is_straggler("n0")
+    fresh = _plane(confirm_batteries=3)
+    for i in range(8):
+        durable = format_ring(plane._rings[f"n{i}"])
+        fresh.adopt_node(
+            make_node(
+                f"n{i}",
+                annotations={KEYS.telemetry_history_annotation: durable},
+            )
+        )
+    # Cohort attribution arrives with the next pass (pool seed + node
+    # labels); the rings themselves carry the history.
+    fresh.seed_pools({f"n{i}": "pool-a" for i in range(8)})
+    for i in range(8):
+        fresh._node_generation[f"n{i}"] = "tpu-v5p-slice"
+    fresh.recompute()
+    assert fresh.is_straggler("n0")
+    assert fresh.metrics_view()["scores"] == plane.metrics_view()["scores"]
+
+
+def test_plane_fails_open_and_counts_drops():
+    plane = _plane()
+
+    class Boom:
+        @property
+        def name(self):
+            raise RuntimeError("boom")
+
+    assert plane.annotation_source(Boom(), "x") is None
+    assert plane.drops == 1
+    # Unparseable values are skipped, not raised.
+    plane.ingest("n0", {"tflops": "not-a-number"})
+    assert "n0" not in plane._rings
+
+
+def test_observe_validation_uses_group_labels():
+    from k8s_operator_libs_tpu.upgrade.consts import (
+        GKE_TPU_ACCELERATOR_LABEL,
+    )
+
+    class _Result:
+        telemetry = {"n0": {"tflops": 240.0}, "n1": {}}
+
+    class _Group:
+        nodes = [
+            make_node("n0", labels={GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p"}),
+        ]
+
+    plane = _plane()
+    plane.observe_validation(_Group(), _Result())
+    assert plane._node_generation["n0"] == "tpu-v5p"
+    assert [s[0] for s in plane._rings["n0"]] == [1]
+    assert "n1" not in plane._rings  # empty stats contribute nothing
+    # No telemetry attribute at all: a plain verdict is not an error.
+    plane.observe_validation(_Group(), object())
+    assert plane.drops == 0
+
+
+def test_metrics_view_attributes_stats_to_checks():
+    plane = _plane()
+    _seed_cohort(plane, batteries=1)
+    view = plane.metrics_view()
+    checks = dict(view["measured"])
+    assert ("mxu_matmul", "tflops") in checks
+    assert ("fused_battery", "battery_execute_ms") in checks
+    assert view["samples_total"] == 8
+    assert view["drops"] == 0
+    assert len(view["scores"]) == 8
+
+
+# --- metrics families + registry self-lint ---------------------------------
+
+
+def test_observe_telemetry_publishes_families():
+    metrics = UpgradeMetrics()
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=3, slow={"n0"})
+
+    class _Mgr:
+        telemetry_plane = plane
+
+    metrics.observe_telemetry(_Mgr())
+    text = metrics.registry.render()
+    assert f'{PREFIX}_node_health_score{{node="n0"}} 0\n' in text
+    assert f'{PREFIX}_node_health_score{{node="n1"}} ' in text
+    assert (
+        f'{PREFIX}_fleet_stragglers{{generation="tpu-v5p-slice",'
+        f'pool="pool-a"}} 1' in text
+    )
+    assert f'{PREFIX}_probe_measured{{check="mxu_matmul"' in text
+    assert f"{PREFIX}_telemetry_samples_total 24" in text
+    assert f"{PREFIX}_telemetry_drops_total 0" in text
+    # A manager without the plane (telemetry disabled) is a no-op.
+    class _Bare:
+        telemetry_plane = None
+
+    metrics.observe_telemetry(_Bare())
+
+
+def test_registry_self_lint():
+    """Every described family: non-empty HELP, prometheus-legal name,
+    counters end in _total and gauges don't, no double registration."""
+    registry = UpgradeMetrics().registry
+    import re
+
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    assert registry.described, "registry describes no families"
+    seen = set()
+    for name in registry.described:
+        assert name not in seen, f"{name} described twice"
+        seen.add(name)
+        assert name_re.match(name), f"{name} is not a legal metric name"
+        assert registry._help[name].strip(), f"{name} has empty HELP"
+        kind = registry.kind(name)
+        assert kind in ("counter", "gauge"), f"{name} kind {kind!r}"
+        assert (kind == "counter") == name.endswith("_total"), (
+            f"{name}: kind {kind!r} disagrees with the _total naming "
+            "convention"
+        )
+
+
+def test_render_emits_type_lines():
+    registry = MetricsRegistry()
+    registry.describe("widgets_total", "Widgets processed")
+    registry.describe("temperature", "Current temperature")
+    registry.inc("widgets_total")
+    registry.set("temperature", 21.5)
+    text = registry.render()
+    assert f"# TYPE {PREFIX}_widgets_total counter" in text
+    assert f"# TYPE {PREFIX}_temperature gauge" in text
+
+
+# --- metrics server: bind address + /healthz -------------------------------
+
+
+def test_metrics_server_healthz_and_default_loopback_bind():
+    registry = MetricsRegistry()
+    registry.describe("nodes_total", "Total managed nodes")
+    registry.set("nodes_total", 3)
+    server = MetricsServer(registry, port=0)
+    assert server.bind_addr == "127.0.0.1"
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.read() == b"ok\n"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+        assert f"{PREFIX}_nodes_total 3" in body
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/other", timeout=5)
+        assert err.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_metrics_server_bind_addr_is_configurable():
+    server = MetricsServer(MetricsRegistry(), port=0, bind_addr="0.0.0.0")
+    assert server.bind_addr == "0.0.0.0"
+    from k8s_operator_libs_tpu.controller import ControllerConfig
+
+    assert ControllerConfig().metrics_bind_addr == "127.0.0.1"
+
+
+# --- status CLI + phase clocks ---------------------------------------------
+
+
+def test_status_telemetry_health_section():
+    from k8s_operator_libs_tpu.status import telemetry_health
+
+    metrics = UpgradeMetrics()
+    plane = _plane(confirm_batteries=3)
+    _seed_cohort(plane, batteries=3, slow={"n0"})
+
+    class _Mgr:
+        telemetry_plane = plane
+
+    metrics.observe_telemetry(_Mgr())
+    text = metrics.registry.render()
+    health = telemetry_health("http://x/metrics", fetch=lambda url: text)
+    assert health["scoredNodes"] == 8
+    assert health["worstNode"] == "n0"
+    assert health["worstScore"] == 0.0
+    assert health["samples"] == 24
+    assert health["stragglers"] == [
+        {"generation": "tpu-v5p-slice", "pool": "pool-a", "count": 1}
+    ]
+    # Absent families (telemetry disabled) → no section at all.
+    assert telemetry_health("http://x/metrics", fetch=lambda url: "") is None
+
+
+def test_status_render_fleet_health():
+    from k8s_operator_libs_tpu.status import render
+
+    status = {
+        "totalManagedNodes": 8,
+        "totalManagedGroups": 2,
+        "upgradesInProgress": 0,
+        "upgradesPending": 0,
+        "upgradesDone": 8,
+        "upgradesFailed": 0,
+        "groups": [],
+        "fleetHealth": {
+            "scoredNodes": 8,
+            "meanScore": 87.5,
+            "worstNode": "n0",
+            "worstScore": 0.0,
+            "samples": 24,
+            "drops": 0,
+            "stragglers": [
+                {"generation": "tpu-v5p-slice", "pool": "pool-a", "count": 1}
+            ],
+        },
+        "policy": {
+            "healthSummary": {
+                "cohorts": [
+                    {
+                        "generation": "tpu-v5p-slice",
+                        "pool": "pool-a",
+                        "nodes": 8,
+                        "baseline": {
+                            "tflops": {"median": 240.0, "mad": 0.6}
+                        },
+                    }
+                ],
+                "scoredNodes": 8,
+                "meanScore": 87.5,
+            },
+            "stragglers": [
+                {
+                    "node": "n0",
+                    "generation": "tpu-v5p-slice",
+                    "pool": "pool-a",
+                    "score": 0.0,
+                    "streak": 3,
+                    "worstStat": "tflops",
+                    "z": 42.0,
+                }
+            ],
+        },
+    }
+    # Live metrics path: distribution head + per-cohort straggler counts.
+    text = render(status)
+    assert "fleet health: 8 node(s) scored" in text
+    assert "worst n0" in text
+    assert "STRAGGLERS tpu-v5p-slice/pool-a: 1" in text
+    # CR fallback (no live metrics consulted): cohort baselines + the
+    # per-node confirmed verdicts from the durable status copy.
+    del status["fleetHealth"]
+    text = render(status)
+    assert "fleet health: 8 node(s) scored" in text
+    assert "tpu-v5p-slice/pool-a: 8 node(s) | tflops 240" in text
+    assert "STRAGGLER n0: score 0.0, z 42.0 on tflops" in text
+
+
+def test_phase_clocks_annotate_straggler_inflated_pools():
+    from k8s_operator_libs_tpu.planning.clocks import PhaseClockTracker
+
+    tracker = PhaseClockTracker()
+    tracker.seed_pools({"n0": "pool-a", "n1": "pool-b"})
+    tracker.set_straggler_nodes(["n0"])
+    out = tracker.to_status()
+    assert out["pool-a"]["stragglersInflatingEta"] == ["n0"]
+    assert "stragglersInflatingEta" not in out.get("pool-b", {})
+    # The annotation is output-only: load_status must skip it safely.
+    tracker.load_status(out)
+    # Clearing the verdict clears the annotation.
+    tracker.set_straggler_nodes([])
+    assert "stragglersInflatingEta" not in tracker.to_status().get(
+        "pool-a", {}
+    )
+
+
+# --- fused/unfused telemetry parity (the capture contract) -----------------
+
+
+SMALL = dict(matmul_n=64, hbm_mib=1, allreduce_elems=128)
+
+
+def test_fused_and_unfused_batteries_feed_identical_stat_keys(cpu_devices):
+    from k8s_operator_libs_tpu.health.probes import run_host_probe
+    from k8s_operator_libs_tpu.health.report import (
+        battery_telemetry,
+        fused_battery_telemetry,
+        measured_node_stats,
+    )
+
+    fused = run_host_probe(cpu_devices, fused=True, **SMALL)
+    unfused = run_host_probe(cpu_devices, fused=False, **SMALL)
+    fused_stats = measured_node_stats(fused)
+    unfused_stats = measured_node_stats(unfused)
+    # Both batteries stamp the same timing key the verdict math uses.
+    assert "battery_execute_ms" in fused_stats
+    assert "battery_execute_ms" in unfused_stats
+    assert fused_stats["battery_execute_ms"] > 0.0
+    assert unfused_stats["battery_execute_ms"] > 0.0
+    # Neither carries cache-hit (an implementation detail, not health).
+    assert "battery_cache_hit" not in fused_stats
+    assert "battery_cache_hit" not in unfused_stats
+    # battery_telemetry reads both; fused_battery_telemetry keeps its
+    # fused-only contract (the status CLI's cold/warm split).
+    assert battery_telemetry(fused).get("fused") == 1.0
+    assert battery_telemetry(unfused).get("fused") == 0.0
+    assert fused_battery_telemetry(fused)
+    assert fused_battery_telemetry(unfused) == {}
+    # The plane scores both without knowing which battery ran.
+    plane = _plane(min_cohort=1)
+    for i, stats in enumerate([fused_stats] * 2 + [unfused_stats] * 2):
+        plane.ingest(f"n{i}", stats, generation="cpu", pool="a")
+    plane.recompute()
+    assert len(plane.metrics_view()["scores"]) == 4

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Render a fleet health report from the telemetry plane.
+
+`make health-report` — the operator-facing view of obs/telemetry.py:
+per-generation cohort baselines (median ± MAD of every measured probe
+stat), the node health-score distribution, and any outliers/confirmed
+stragglers.  Two sources:
+
+- ``--metrics-url http://host:port/metrics`` reads a live controller's
+  exposition (the same families the status CLI consumes:
+  node_health_score, fleet_stragglers, probe_measured).
+- default: builds a fake mixed-generation fleet, seeds a TelemetryPlane
+  with synthetic probe histories (one injected straggler per
+  generation), and reports on that — the quickest way to SEE what the
+  telemetry plane produces without standing up a controller.
+
+Zero external dependencies; the fake path uses only the repo itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+# Fake-fleet shape: (generation, pool, node count, baseline stats).
+FAKE_COHORTS = [
+    ("tpu-v4-podslice", "pool-a", 16, {"tflops": 240.0, "gbps": 980.0}),
+    ("tpu-v5-lite-podslice", "pool-b", 16, {"tflops": 360.0, "gbps": 1400.0}),
+    ("tpu-v6e-slice", "pool-c", 16, {"tflops": 880.0, "gbps": 3200.0}),
+]
+FAKE_BATTERIES = 4
+# Injected straggler: last node of each cohort runs this fraction of
+# its generation's baseline.
+FAKE_STRAGGLER_FRACTION = 0.75
+
+SCORE_BUCKETS = [(90.0, "90-100"), (75.0, "75-90"), (50.0, "50-75"),
+                 (25.0, "25-50"), (0.0, "0-25")]
+
+
+def build_fake_plane():
+    """Seed a TelemetryPlane from a synthetic mixed-generation fleet."""
+    from k8s_operator_libs_tpu.obs.telemetry import TelemetryPlane
+
+    plane = TelemetryPlane()
+    # Deterministic jitter so MAD is non-zero without pulling in random.
+    for gen, pool, count, stats in FAKE_COHORTS:
+        for battery in range(FAKE_BATTERIES):
+            for i in range(count):
+                scale = 1.0 + 0.004 * ((i * 7 + battery * 3) % 5 - 2)
+                if i == count - 1:
+                    scale *= FAKE_STRAGGLER_FRACTION
+                sample = {k: v * scale for k, v in stats.items()}
+                sample["battery_execute_ms"] = 40.0 / scale
+                plane.ingest(
+                    f"{gen.split('-')[1]}-{pool}-w{i}",
+                    sample,
+                    generation=gen,
+                    pool=pool,
+                )
+    plane.recompute()
+    return plane
+
+
+def report_from_plane(plane) -> dict:
+    """Shape a report dict from a live TelemetryPlane instance."""
+    status = plane.to_status()
+    view = plane.metrics_view()
+    return {
+        "cohorts": (status.get("healthSummary") or {}).get("cohorts") or [],
+        "scores": view["scores"],
+        "stragglers": status.get("stragglers") or [],
+        "samples": view["samples_total"],
+        "drops": view["drops"],
+        "measured": {
+            f"{check}/{stat}": val
+            for (check, stat), val in sorted(view["measured"].items())
+        },
+    }
+
+
+def report_from_metrics(metrics_url: str) -> dict:
+    """Shape the same report from a controller's /metrics exposition."""
+    from k8s_operator_libs_tpu.metrics import PREFIX
+    from urllib.request import urlopen
+
+    with urlopen(metrics_url, timeout=5) as resp:
+        text = resp.read().decode()
+    scores: dict[str, float] = {}
+    measured: dict[str, float] = {}
+    stragglers: list[dict] = []
+    samples = drops = 0
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+
+        def label(key: str) -> str:
+            part = labels.split(f'{key}="', 1)
+            return part[1].split('"', 1)[0] if len(part) == 2 else ""
+
+        if short == "node_health_score":
+            scores[label("node")] = val
+        elif short == "fleet_stragglers" and val:
+            stragglers.append(
+                {
+                    "generation": label("generation"),
+                    "pool": label("pool"),
+                    "count": int(val),
+                }
+            )
+        elif short == "probe_measured":
+            measured[f"{label('check')}/{label('stat')}"] = val
+        elif short == "telemetry_samples_total":
+            samples = int(val)
+        elif short == "telemetry_drops_total":
+            drops = int(val)
+    return {
+        "cohorts": [],  # per-cohort baselines live on the CR, not /metrics
+        "scores": scores,
+        "stragglers": stragglers,
+        "samples": samples,
+        "drops": drops,
+        "measured": measured,
+    }
+
+
+def render(report: dict) -> str:
+    lines = []
+    scores = report["scores"]
+    lines.append(
+        f"fleet health report: {len(scores)} node(s) scored | "
+        f"{report['samples']} sample(s) ingested, "
+        f"{report['drops']} drop(s)"
+    )
+    if report["cohorts"]:
+        lines.append("")
+        lines.append("per-generation baselines (median ± MAD):")
+        for cohort in report["cohorts"]:
+            stats = ", ".join(
+                f"{stat} {b['median']:g}±{b['mad']:g}"
+                for stat, b in sorted(cohort.get("baseline", {}).items())
+            )
+            lines.append(
+                f"  {cohort['generation'] or '?':22s} "
+                f"{cohort['pool'] or 'default':10s} "
+                f"{cohort['nodes']:>3d} node(s)  {stats}"
+            )
+    if report["measured"]:
+        lines.append("")
+        lines.append("fleet-median measured stats (latest battery):")
+        for key, val in sorted(report["measured"].items()):
+            lines.append(f"  {key:36s} {val:g}")
+    if scores:
+        lines.append("")
+        lines.append("score distribution:")
+        total = len(scores)
+        counts = {label: 0 for _, label in SCORE_BUCKETS}
+        for s in scores.values():
+            for floor, bucket_label in SCORE_BUCKETS:
+                if s >= floor:
+                    counts[bucket_label] += 1
+                    break
+        for _, bucket_label in SCORE_BUCKETS:
+            n = counts[bucket_label]
+            bar = "#" * max(1, round(40 * n / total)) if n else ""
+            lines.append(f"  {bucket_label:>7s}  {n:>4d}  {bar}")
+        worst = sorted(scores.items(), key=lambda kv: kv[1])[:5]
+        outliers = [(n, s) for n, s in worst if s < 75.0]
+        if outliers:
+            lines.append("")
+            lines.append("outliers (score < 75):")
+            for node, score in outliers:
+                lines.append(f"  {node:36s} {score:.1f}")
+    if report["stragglers"]:
+        lines.append("")
+        lines.append("confirmed stragglers:")
+        for s in report["stragglers"]:
+            if "node" in s:
+                lines.append(
+                    f"  {s['node']:36s} "
+                    f"{s.get('generation', '') or '?'}/"
+                    f"{s.get('pool', '') or 'default'}  score "
+                    f"{s.get('score', 0.0)}  z {s.get('z', 0.0)} on "
+                    f"{s.get('worstStat', '')} over "
+                    f"{s.get('streak', 0)} batteries"
+                )
+            else:
+                lines.append(
+                    f"  {s.get('generation', '') or '?'}/"
+                    f"{s.get('pool', '') or 'default'}: "
+                    f"{s.get('count', 0)} node(s)"
+                )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--metrics-url",
+        default="",
+        help="read a live controller's /metrics instead of the fake fleet",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the report dict as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+    if args.metrics_url:
+        report = report_from_metrics(args.metrics_url)
+    else:
+        report = report_from_plane(build_fake_plane())
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
