@@ -40,15 +40,12 @@ test-fast:
 		--ignore=tests/test_ring_attention.py \
 		--ignore=tests/test_chaos.py
 
-# Just the fault-injection tiers (chaos + seeded fuzz + node faults):
-# full rolls through API fault schedules, mid-roll hardware loss, slice
-# quarantine, and the eviction ladder.  PYTHONHASHSEED pins the one
-# remaining source of cross-run variation (set ordering); the fuzz
-# scenarios themselves are already seed-parameterized.
+# The fault-injection ladder (breaker/retry, node faults, chaos rolls,
+# seeded fuzz, federation partitions), one pytest process per battery
+# with a summary table — tools/chaos_run.py pins PYTHONHASHSEED=0 and
+# isolates each battery so a crash or hang cannot mask the rest.
 chaos:
-	PYTHONHASHSEED=0 $(PYTHON) -m pytest -q \
-		tests/test_chaos.py tests/test_fuzz_invariants.py \
-		tests/test_node_faults.py
+	$(PYTHON) tools/chaos_run.py
 
 # The in-repo linter (tools/lint.py: syntax, unused imports, undefined
 # names, bare excepts, mutable defaults) is the hard gate and always

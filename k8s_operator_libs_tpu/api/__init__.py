@@ -5,6 +5,9 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
     DriverUpgradePolicySpec,
     ElasticCoordinationSpec,
     EvictionEscalationSpec,
+    FederationCanarySpec,
+    FederationClusterSpec,
+    FederationSpec,
     IntOrString,
     PlanningSpec,
     PodDeletionSpec,

@@ -82,6 +82,15 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("PlanningSpec", "admission_mode"): {
         "enum": list(PlanningSpec.ADMISSION_MODES)
     },
+    ("FederationClusterSpec", "name"): {"pattern": "^.+$"},
+    ("FederationClusterSpec", "region"): {"pattern": "^.+$"},
+    ("FederationCanarySpec", "region"): {"pattern": "^.+$"},
+    ("FederationCanarySpec", "soak_second"): {"minimum": 0},
+    ("FederationSpec", "max_parallel_upgrades"): {"minimum": 0},
+    ("FederationSpec", "degraded_after_probes"): {"minimum": 1},
+    ("FederationSpec", "partitioned_after_probes"): {"minimum": 1},
+    ("FederationSpec", "heal_probes"): {"minimum": 1},
+    ("FederationSpec", "lease_duration_second"): {"minimum": 0},
 }
 
 

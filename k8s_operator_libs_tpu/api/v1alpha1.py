@@ -94,6 +94,8 @@ _JSON_NAME_OVERRIDES = {
     "rejoin_timeout_second": "rejoinTimeoutSeconds",
     "drift_threshold_second": "driftThresholdSeconds",
     "replan_interval_second": "replanIntervalSeconds",
+    "soak_second": "soakSeconds",
+    "lease_duration_second": "leaseDurationSeconds",
 }
 
 
@@ -526,6 +528,133 @@ class PlanningSpec(_SpecBase):
 
 
 @dataclass
+class FederationClusterSpec(_SpecBase):
+    """One member cluster of a federated roll."""
+
+    # Unique cluster name (the budget-hierarchy key).
+    name: str = ""
+    # Region the cluster belongs to (the canary/promotion unit).
+    region: str = ""
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("federation cluster: name is required")
+        if not self.region:
+            raise ValidationError(
+                f"federation cluster {self.name!r}: region is required"
+            )
+
+
+@dataclass
+class FederationCanarySpec(_SpecBase):
+    """Regional canary gate for federated rolls."""
+
+    # Region that rolls first (must match a cluster's region).
+    region: str = ""
+    # Seconds the canary region's health baselines must stay clean
+    # after its roll completes before promotion to remaining regions.
+    soak_second: int = 300
+
+    def validate(self) -> None:
+        if not self.region:
+            raise ValidationError("federation.canary.region is required")
+        if self.soak_second < 0:
+            raise ValidationError(
+                "federation.canary.soakSeconds must be >= 0"
+            )
+
+
+@dataclass
+class FederationSpec(_SpecBase):
+    """Federated (multi-cluster) roll configuration.
+
+    Declares the member clusters, the canary region and soak, the
+    GLOBAL unavailability budget (checked-and-charged above every
+    cluster's own caps: global ∧ cluster ∧ pool), and the health-probe
+    ladder that drives fail-static degradation (Reachable → Degraded →
+    Partitioned).  See docs/federation.md.
+    """
+
+    enable: bool = False
+    # Member clusters; each name must be unique.
+    clusters: list[FederationClusterSpec] = field(default_factory=list)
+    # Regional canary gate (required when enabled).
+    canary: Optional[FederationCanarySpec] = None
+    # GLOBAL maxUnavailable across every cluster (int or percentage of
+    # the federation's total units); unset = no global cap beyond the
+    # per-cluster policies.
+    max_unavailable: Optional[IntOrString] = None
+    # Global in-flight group ceiling across clusters (0 = unlimited).
+    max_parallel_upgrades: int = 0
+    # Consecutive failed health probes before a cluster is Degraded.
+    degraded_after_probes: int = 1
+    # Consecutive failed probes before Partitioned (an open circuit
+    # breaker escalates straight here).
+    partitioned_after_probes: int = 3
+    # Consecutive clean probes a Partitioned cluster needs to step back
+    # down the ladder (hysteresis against flapping WAN links).
+    heal_probes: int = 2
+    # Observer-clock staleness bound for member controller leases.
+    lease_duration_second: int = 30
+
+    def validate(self) -> None:
+        if not self.enable:
+            return
+        if not self.clusters:
+            raise ValidationError(
+                "federation.enable requires at least one cluster"
+            )
+        seen: set[str] = set()
+        regions: set[str] = set()
+        for cluster in self.clusters:
+            cluster.validate()
+            if cluster.name in seen:
+                raise ValidationError(
+                    f"duplicate federation cluster name {cluster.name!r}"
+                )
+            seen.add(cluster.name)
+            regions.add(cluster.region)
+        if self.canary is None:
+            raise ValidationError(
+                "federation.enable requires federation.canary"
+            )
+        self.canary.validate()
+        if self.canary.region not in regions:
+            raise ValidationError(
+                f"federation.canary.region {self.canary.region!r} "
+                f"matches no cluster's region"
+            )
+        if self.max_parallel_upgrades < 0:
+            raise ValidationError(
+                "federation.maxParallelUpgrades must be >= 0"
+            )
+        if self.degraded_after_probes < 1:
+            raise ValidationError(
+                "federation.degradedAfterProbes must be >= 1"
+            )
+        if self.partitioned_after_probes < self.degraded_after_probes:
+            raise ValidationError(
+                "federation.partitionedAfterProbes must be >= "
+                "degradedAfterProbes"
+            )
+        if self.heal_probes < 1:
+            raise ValidationError("federation.healProbes must be >= 1")
+        if self.lease_duration_second < 0:
+            raise ValidationError(
+                "federation.leaseDurationSeconds must be >= 0"
+            )
+        huge = 1 << 30
+        if (
+            self.max_unavailable is not None
+            and self.max_unavailable.scaled_value(huge, round_up=True) == 0
+        ):
+            raise ValidationError(
+                "federation.maxUnavailable admits zero units: the global "
+                "roll can never start (plan-infeasible)"
+            )
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -581,6 +710,10 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # Predictive rollout planning / drift-watchdog knobs; None = planner
     # defaults (planning is always on — it is read-only).
     planning: Optional[PlanningSpec] = None
+    # Federated (multi-cluster) roll: member clusters, regional canary
+    # gate, global budget, partition-tolerance ladder.  None/disabled =
+    # single-cluster behavior unchanged.
+    federation: Optional[FederationSpec] = None
 
     def validate(self) -> None:
         super().validate()
@@ -601,6 +734,8 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.elastic.validate()
         if self.planning is not None:
             self.planning.validate()
+        if self.federation is not None:
+            self.federation.validate()
         seen_pools: set[str] = set()
         for pool in self.pools:
             pool.validate()
@@ -667,7 +802,10 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("TPUUpgradePolicySpec", "slice_quarantine"): SliceQuarantineSpec,
     ("TPUUpgradePolicySpec", "elastic"): ElasticCoordinationSpec,
     ("TPUUpgradePolicySpec", "planning"): PlanningSpec,
+    ("TPUUpgradePolicySpec", "federation"): FederationSpec,
+    ("FederationSpec", "canary"): FederationCanarySpec,
     # List-of-nested: from_dict maps each element through the type.
     ("TPUUpgradePolicySpec", "pools"): PoolSpec,
+    ("FederationSpec", "clusters"): FederationClusterSpec,
     ("PoolSpec", "maintenance_window"): MaintenanceWindowSpec,
 }

@@ -580,6 +580,89 @@ class UpgradeMetrics:
             "Telemetry-plane fail-open exceptions swallowed (capture, "
             "persistence, or adoption path)",
         )
+        r.describe(
+            "federation_cluster_health",
+            "Member-cluster control-plane health ladder rung "
+            "(0=Reachable, 1=Degraded, 2=Partitioned)",
+            "cluster",
+            "region",
+        )
+        r.describe(
+            "federation_cluster_done",
+            "1 when every group in the member cluster reached "
+            "upgrade-done this federated roll",
+            "cluster",
+        )
+        r.describe(
+            "federation_frozen_groups",
+            "Budget charges held fail-static for a partitioned member "
+            "cluster (released only on heal-time re-adoption)",
+            "cluster",
+        )
+        r.describe(
+            "federation_probes_total",
+            "Cross-cluster reachability probes issued by the registry",
+        )
+        r.describe(
+            "federation_probe_failures_total",
+            "Reachability probes that failed (hard or breaker-open)",
+        )
+        r.describe(
+            "federation_partitions_total",
+            "Member clusters stepped onto the Partitioned rung",
+        )
+        r.describe(
+            "federation_heals_total",
+            "Member clusters stepped back off the Partitioned rung",
+        )
+        r.describe(
+            "federation_phase",
+            "Federated roll phase (1 on the current phase's series)",
+            "phase",
+        )
+        r.describe(
+            "federation_canary_held",
+            "1 while the canary gate holds promotion on a confirmed "
+            "telemetry regression",
+        )
+        r.describe(
+            "federation_canary_holds_total",
+            "Canary promotion holds latched over the coordinator's "
+            "lifetime",
+        )
+        r.describe(
+            "federation_soak_remaining_seconds",
+            "Seconds of clean canary soak still required before "
+            "promotion",
+        )
+        r.describe(
+            "federation_budget_unavailable_used",
+            "Units currently charged against the global unavailability "
+            "budget across all member clusters",
+        )
+        r.describe(
+            "federation_budget_unavailable_cap",
+            "Global unavailability budget cap in units",
+        )
+        r.describe(
+            "federation_budget_parallel_used",
+            "Groups concurrently in flight against the global parallel "
+            "cap",
+        )
+        r.describe(
+            "federation_budget_denials_total",
+            "Admission attempts denied by the global budget hierarchy",
+        )
+        r.describe(
+            "federation_budget_violations_total",
+            "Non-forced grants observed above the global cap (must stay "
+            "0)",
+        )
+        r.describe(
+            "federation_store_writes_total",
+            "Writes the durable federation state store issued (phase "
+            "edges only, never per tick)",
+        )
         # api_requests_per_tick baseline: total verb count at the end of
         # the previous observe() call.
         self._last_api_total: Optional[float] = None
@@ -947,6 +1030,87 @@ class UpgradeMetrics:
             r.set("probe_measured", value, check=check, stat=stat)
         r.set("telemetry_samples_total", view["samples_total"])
         r.set("telemetry_drops_total", view["drops"])
+
+    def observe_federation(self, coordinator) -> None:
+        """Publish the federated control-plane surface (federation/):
+        the per-cluster health ladder, fail-static freeze depth, the
+        canary gate, and the global budget hierarchy's counters.
+        Cleared-then-set for every labelled family so removed clusters
+        and stale phases don't linger.  getattr-guarded like the other
+        observe_* hooks: a bare manager publishes nothing."""
+        registry = getattr(coordinator, "registry", None)
+        if registry is None:
+            return
+        r = self.registry
+        rung = {"Reachable": 0.0, "Degraded": 1.0, "Partitioned": 2.0}
+        healths = registry.healths()
+        done = getattr(coordinator, "_done", {})
+        r.clear("federation_cluster_health")
+        r.clear("federation_cluster_done")
+        r.clear("federation_frozen_groups")
+        for member in registry.members():
+            health = healths[member.name].value
+            r.set(
+                "federation_cluster_health",
+                rung.get(health, 2.0),
+                cluster=member.name,
+                region=member.region,
+            )
+            r.set(
+                "federation_cluster_done",
+                1.0 if done.get(member.name) else 0.0,
+                cluster=member.name,
+            )
+            r.set(
+                "federation_frozen_groups",
+                len(member.frozen_groups),
+                cluster=member.name,
+            )
+        stats = registry.stats
+        r.set("federation_probes_total", stats.get("probes", 0))
+        r.set(
+            "federation_probe_failures_total",
+            stats.get("probe_failures", 0),
+        )
+        r.set("federation_partitions_total", stats.get("partitions", 0))
+        r.set("federation_heals_total", stats.get("heals", 0))
+        r.clear("federation_phase")
+        r.set("federation_phase", 1.0, phase=coordinator.phase)
+        gate = getattr(coordinator, "gate", None)
+        if gate is not None:
+            verdict = gate.evaluate()
+            r.set(
+                "federation_canary_held",
+                1.0 if gate.held is not None else 0.0,
+            )
+            r.set("federation_canary_holds_total", gate.holds_total)
+            r.set(
+                "federation_soak_remaining_seconds",
+                round(verdict.soak_remaining_s, 3),
+            )
+        ledger = getattr(coordinator, "global_ledger", None)
+        if ledger is not None:
+            r.set(
+                "federation_budget_unavailable_used",
+                ledger.unavailable_used(),
+            )
+            r.set(
+                "federation_budget_unavailable_cap",
+                ledger.max_unavailable,
+            )
+            r.set(
+                "federation_budget_parallel_used", ledger.parallel_used()
+            )
+            r.set("federation_budget_denials_total", ledger.denials)
+            r.set(
+                "federation_budget_violations_total", ledger.violations
+            )
+        store = getattr(coordinator, "store", None)
+        if store is not None:
+            r.set(
+                "federation_store_writes_total",
+                getattr(store, "writes", 0),
+            )
 
     def observe_sharded(self, sharded, report=None) -> None:
         """Publish the sharded-reconcile surface.  Called with a

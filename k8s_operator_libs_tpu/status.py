@@ -430,6 +430,76 @@ def telemetry_health(metrics_url: str, fetch=None) -> Optional[dict]:
     return out if (scores or stragglers or out.get("samples")) else None
 
 
+def federation_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Federated control plane from the controller's /metrics: the
+    per-cluster health ladder, fail-static freeze depth, the canary
+    gate, and the global budget counters.
+
+    Returns None when the family is absent (federation disabled), an
+    ``{"error": ...}`` dict when the endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    rung = {0: "Reachable", 1: "Degraded", 2: "Partitioned"}
+
+    def _label(labels: str, key: str) -> str:
+        part = labels.split(f'{key}="', 1)
+        return part[1].split('"', 1)[0] if len(part) == 2 else ""
+
+    clusters: dict[str, dict] = {}
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_federation_"):
+            continue
+        short = name[len(PREFIX) + 12 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "cluster_health":
+            row = clusters.setdefault(_label(labels, "cluster"), {})
+            row["region"] = _label(labels, "region")
+            row["health"] = rung.get(int(val), "Partitioned")
+        elif short == "cluster_done":
+            clusters.setdefault(_label(labels, "cluster"), {})["done"] = (
+                bool(val)
+            )
+        elif short == "frozen_groups" and val:
+            clusters.setdefault(_label(labels, "cluster"), {})[
+                "frozenGroups"
+            ] = int(val)
+        elif short == "phase" and val:
+            out["phase"] = _label(labels, "phase")
+        elif short == "canary_held":
+            out["canaryHeld"] = bool(val)
+        elif short == "soak_remaining_seconds" and val:
+            out["soakRemainingSeconds"] = val
+        elif short == "budget_unavailable_used":
+            out["budgetUsed"] = int(val)
+        elif short == "budget_unavailable_cap":
+            out["budgetCap"] = int(val)
+        elif short == "budget_parallel_used":
+            out["budgetParallel"] = int(val)
+        elif short == "budget_violations_total":
+            out["budgetViolations"] = int(val)
+        elif short == "partitions_total":
+            out["partitions"] = int(val)
+        elif short == "heals_total":
+            out["heals"] = int(val)
+    if clusters:
+        out["clusters"] = {
+            name: clusters[name] for name in sorted(clusters)
+        }
+    return out if (clusters or "phase" in out) else None
+
+
 def gather(
     client: KubeClient,
     namespace: str,
@@ -695,6 +765,9 @@ def gather(
         health = telemetry_health(metrics_url, fetch=metrics_fetch)
         if health is not None:
             out["fleetHealth"] = health
+        federation = federation_health(metrics_url, fetch=metrics_fetch)
+        if federation is not None:
+            out["federation"] = federation
     if hasattr(client, "list_events"):
         warnings = [
             e
@@ -1062,6 +1135,50 @@ def render(status: dict) -> str:
                     f"{v.get('score', 0.0)}, z {v.get('z', 0.0)} on "
                     f"{v.get('worstStat', '')} over "
                     f"{int(v.get('streak', 0))} consecutive batteries"
+                )
+    federation = status.get("federation")
+    if federation is not None:
+        lines.append("")
+        if "error" in federation:
+            lines.append(f"federation: {federation['error']}")
+        else:
+            head = f"federation: phase {federation.get('phase', '?')}"
+            if "budgetCap" in federation:
+                head += (
+                    f" | global budget "
+                    f"{int(federation.get('budgetUsed', 0))}/"
+                    f"{int(federation['budgetCap'])} unavailable, "
+                    f"{int(federation.get('budgetParallel', 0))} "
+                    "parallel"
+                )
+            if federation.get("budgetViolations"):
+                head += (
+                    f" | {int(federation['budgetViolations'])} "
+                    "VIOLATION(S)"
+                )
+            if federation.get("partitions"):
+                head += (
+                    f" | {int(federation['partitions'])} partition(s), "
+                    f"{int(federation.get('heals', 0))} heal(s)"
+                )
+            lines.append(head)
+            for name, row in (federation.get("clusters") or {}).items():
+                detail = row.get("health", "?")
+                if row.get("done"):
+                    detail += ", done"
+                if row.get("frozenGroups"):
+                    detail += (
+                        f", {int(row['frozenGroups'])} frozen group(s)"
+                    )
+                lines.append(
+                    f"  {name} ({row.get('region', '?')}): {detail}"
+                )
+            if federation.get("canaryHeld"):
+                lines.append("  canary: HELD — promotion stopped")
+            elif federation.get("soakRemainingSeconds"):
+                lines.append(
+                    f"  canary: soaking, "
+                    f"{federation['soakRemainingSeconds']:.0f}s remaining"
                 )
     breakdown = (status.get("policy") or {}).get("makespanBreakdown")
     if breakdown:

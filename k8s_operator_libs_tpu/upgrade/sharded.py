@@ -280,6 +280,14 @@ class LedgerSnapshot(dict):
     """Plain-dict view of the ledger for logging/metrics."""
 
 
+class LedgerError(RuntimeError):
+    """A caller violated a ledger invariant (negative charge, strict-mode
+    double release).  Raised instead of silently corrupting the budget
+    hierarchy: a negative cost would mint capacity out of thin air, and a
+    double release under a federated parent would free the same global
+    units twice."""
+
+
 class BudgetLedger:
     """Fleet-wide, atomic ``maxUnavailable`` / ``maxParallelUpgrades`` /
     DCN-anti-affinity arbitration for parallel shards.
@@ -328,6 +336,21 @@ class BudgetLedger:
         # ``trace_hook(verdict, group_id, **info)`` outside the lock,
         # never allowed to fail a claim.
         self.trace_hook: Optional[Callable[..., None]] = None
+        # Federated hierarchy (federation/ledger.py): when set, a claim
+        # must clear this cluster's caps AND the parent's global ∧
+        # cluster caps — global ∧ cluster ∧ pool.  The parent is
+        # consulted while this ledger's lock is held (lock order is
+        # strictly cluster → global; the global ledger never calls back
+        # into a cluster ledger), charged under ``cluster_name``, and
+        # released/resynced in step with the local charge.
+        self.parent = None
+        self.cluster_name = ""
+        # Opt-in strict mode: releasing a group that holds no charge
+        # raises LedgerError instead of being a silent no-op.  The engine
+        # deliberately stays tolerant (it calls release as an idempotent
+        # "ensure free" on several exit paths); the federation tier and
+        # the guard tests opt in.
+        self.strict_release = False
 
     def _tap(self, verdict: str, group_id: str, **info) -> None:
         hook = self.trace_hook
@@ -426,12 +449,20 @@ class BudgetLedger:
         Never charges and never registers a waiter — the admission
         pass's idle-budget canary and the targeted wakeup path use it
         to ask without committing."""
+        if cost < 0:
+            raise LedgerError(
+                f"negative charge for {group_id!r}: {cost}"
+            )
         if pool is None and self.pool_resolver is not None:
             pool = self.pool_resolver(group_id)
         with self._lock:
             if group_id in self._charges:
                 return True
-            return not self._denied_locked(group_id, cost, dcn_group, pool)
+            if self._denied_locked(group_id, cost, dcn_group, pool):
+                return False
+        if self.parent is not None:
+            return self.parent.can_claim(self.cluster_name, group_id, cost)
+        return True
 
     def try_claim(
         self,
@@ -448,15 +479,27 @@ class BudgetLedger:
         the charge so other claims see it.  ``pool`` scopes the claim to
         a per-pool budget when the policy declares pools; omitted, the
         installed ``pool_resolver`` is consulted."""
+        if cost < 0:
+            raise LedgerError(
+                f"negative charge for {group_id!r}: {cost}"
+            )
         if pool is None and self.pool_resolver is not None:
             pool = self.pool_resolver(group_id)
         with self._lock:
             if group_id in self._charges:
-                # Idempotent re-claim by the group's own pool.
+                # Idempotent re-claim by the group's own pool.  A parent
+                # that lost this charge (e.g. rebaselined while the group
+                # stayed in flight) is force-recharged: the unavailability
+                # is a fact, not an admission request.
                 if dcn_group is not None:
                     self._dcn_of[group_id] = dcn_group
                 if pool is not None:
                     self._pool_of_charge[group_id] = pool
+                if self.parent is not None:
+                    self.parent.try_claim(
+                        self.cluster_name, group_id,
+                        self._charges[group_id], force=True,
+                    )
                 return True
             if not force:
                 if self._denied_locked(group_id, cost, dcn_group, pool):
@@ -466,6 +509,14 @@ class BudgetLedger:
                     denied = False
             else:
                 denied = False
+            if not denied and self.parent is not None:
+                # Global ∧ cluster gate, checked-and-charged atomically
+                # under the cluster lock (lock order cluster → global).
+                if not self.parent.try_claim(
+                    self.cluster_name, group_id, cost, force=force
+                ):
+                    self._waiters.add(group_id)
+                    denied = True
             if not denied:
                 self._charges[group_id] = cost
                 self._waiters.discard(group_id)
@@ -491,6 +542,15 @@ class BudgetLedger:
             self._waiters.discard(group_id)
             if had is not None and self._waiters:
                 waiters, self._waiters = self._waiters, set()
+        if had is None and self.strict_release:
+            raise LedgerError(
+                f"double release of {group_id!r}: no charge held"
+            )
+        # Parent release only for a REAL release — the engine's
+        # idempotent "ensure free" no-ops never reach the global ledger,
+        # so its own strict double-release guard stays sound.
+        if had is not None and self.parent is not None:
+            self.parent.release(self.cluster_name, group_id)
         # Callback OUTSIDE the lock: it marks the dirty queue (its own
         # lock) and may wake the controller.
         if had is not None:
@@ -663,6 +723,15 @@ class BudgetLedger:
             self.external_unavailable = external
             self._pool_caps = pool_caps
             self._pool_of_charge = pool_of_charge
+        # Rebaseline this cluster's slice of the federated parent from
+        # the same observed snapshot (outside the lock: cluster → global
+        # order, and sync_cluster takes only the global lock).  Other
+        # clusters' charges — including a partitioned peer's fail-static
+        # reservations — are untouched.
+        if self.parent is not None:
+            self.parent.sync_cluster(
+                self.cluster_name, charges, total_units=total, unit=unit
+            )
 
 
 @dataclass

@@ -1836,3 +1836,89 @@ def test_telemetry_ring_survives_crash_between_batteries():
         assert ring[0] == before[name][0], (
             f"{name}: battery-1 sample mutated across the crash"
         )
+
+
+def test_lease_lost_between_build_state_and_flush_abandons_batch():
+    """The narrowest fencing window: leadership is lost AFTER the
+    snapshot is built but BEFORE the write plan flushes.  The deposed
+    controller's whole staged batch must drop at the fence — zero
+    mutations, node labels byte-identical — and after the new leader
+    adopts and finishes the roll, no node transition was ever written
+    twice (the fence plus label-mailbox idempotency, not luck)."""
+    from collections import Counter
+
+    store = FakeCluster()
+    keys = UpgradeKeys()
+    nodes = _upgrade_scenario(store, keys, slices=2, hosts=2)
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True, drain_spec=DrainSpec(enable=False)
+    )
+
+    alive = {"up": True}
+    client_a = _CountingClient(store)
+    mgr_a = ClusterUpgradeStateManager(
+        client_a, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    mgr_a.fence = lambda: alive["up"]
+    state = mgr_a.build_state(NAMESPACE, DRIVER_LABELS)
+    mgr_a.adopt(state, identity="ctl-a", term=1)
+    baseline_mutations = client_a.mutations
+
+    # The doomed pass: snapshot built while still leader ...
+    state = mgr_a.build_state(NAMESPACE, DRIVER_LABELS)
+    labels_before = {
+        n.name: dict(store.get_node(n.name, cached=False).labels)
+        for n in nodes
+    }
+    # ... lease lost RIGHT HERE (between build_state and flush) ...
+    alive["up"] = False
+    mgr_a.apply_state(state, policy)
+    mgr_a.wait_for_async_work(10.0)
+    # ... and the fence dropped the ENTIRE staged batch.
+    assert client_a.mutations == baseline_mutations
+    assert mgr_a.write_plan.stats.get("fenced_drops", 0) > 0
+    labels_after = {
+        n.name: dict(store.get_node(n.name, cached=False).labels)
+        for n in nodes
+    }
+    assert labels_after == labels_before
+
+    # The new leader adopts (term 2) and drives the roll to done.
+    transitions: Counter = Counter()
+    client_b = _CountingClient(store)
+    mgr_b = ClusterUpgradeStateManager(
+        client_b, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+    )
+    mgr_b.provider.add_transition_observer(
+        lambda ns, st: transitions.update(
+            (n.name, st.value) for n in ns
+        )
+    )
+    state_b = mgr_b.build_state(NAMESPACE, DRIVER_LABELS)
+    mgr_b.adopt(state_b, identity="ctl-b", term=2)
+    for _ in range(200):
+        state_b = mgr_b.build_state(NAMESPACE, DRIVER_LABELS)
+        mgr_b.apply_state(state_b, policy)
+        mgr_b.wait_for_async_work(10.0)
+        states = {
+            store.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {"upgrade-done"}:
+            break
+    else:
+        pytest.fail(f"successor never converged: {states}")
+
+    # The deposed controller keeps reconciling on its stale snapshot —
+    # every later flush must keep dropping at the fence.
+    mgr_a.apply_state(state, policy)
+    mgr_a.wait_for_async_work(10.0)
+    assert client_a.mutations == baseline_mutations
+
+    # No double-writes anywhere: every (node, state) transition the
+    # successor staged was staged exactly once.
+    assert transitions, "successor staged no transitions"
+    repeats = {k: c for k, c in transitions.items() if c > 1}
+    assert repeats == {}, f"repeated transitions: {repeats}"

@@ -273,6 +273,61 @@ class TestCircuitBreaker:
         assert b.open_endpoints() == {}
         assert b.describe_open() == ""
 
+    def test_halfopen_admits_exactly_one_concurrent_probe(self):
+        """N callers hit an open endpoint the instant the reset window
+        opens: EXACTLY ONE wins the half-open probe slot, every loser
+        fast-fails without touching the endpoint, and the winner's
+        success closes the circuit for all — the thundering-herd guard
+        a federated registry leans on when a partitioned region heals
+        and every member's probe fires in the same tick."""
+        import threading
+
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.0)
+        ep = "GET nodes"
+        b.record_failure(ep, TimeoutError("down"))
+        assert ep in b.open_endpoints()
+        callers = 16
+        barrier = threading.Barrier(callers)
+        verdicts = [None] * callers
+
+        def caller(i):
+            barrier.wait()
+            verdicts[i] = b.allow(ep)
+
+        threads = [
+            threading.Thread(target=caller, args=(i,))
+            for i in range(callers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        assert verdicts.count(True) == 1, f"probe slot raced: {verdicts}"
+        assert verdicts.count(False) == callers - 1
+        assert b.fast_fails == callers - 1
+        # Losers failed FAST — none recorded a failure, so the breaker
+        # still holds exactly the original open state.
+        assert ep in b.open_endpoints()
+        # The winner's probe succeeds: the circuit closes for everyone.
+        b.record_success(ep)
+        results = [b.allow(ep) for _ in range(callers)]
+        assert all(results)
+        assert b.open_endpoints() == {}
+
+    def test_halfopen_probe_failure_keeps_losers_fast_failing(self):
+        """The dual: the probe winner fails, the circuit re-opens, and
+        the next reset window again admits exactly one probe."""
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.0)
+        ep = "PATCH nodes"
+        b.record_failure(ep, TimeoutError("down"))
+        assert b.allow(ep)          # probe slot taken
+        assert not b.allow(ep)      # concurrent caller fast-fails
+        b.record_failure(ep, TimeoutError("still down"))
+        assert b.allow(ep)          # new window, new single probe
+        assert not b.allow(ep)
+        b.record_success(ep)
+        assert b.allow(ep) and b.allow(ep)
+
     def test_definitive_verdict_resets_the_count(self):
         """Interleaved 404s prove the endpoint is alive: consecutive
         transient failures, not cumulative ones, open the circuit."""

@@ -1,0 +1,1081 @@
+"""Federated control plane: the partition-tolerance tentpole.
+
+Covers the four layers of ``federation/`` — the cluster health ladder
+(registry), the global budget hierarchy (GlobalBudgetLedger as parent of
+every member's BudgetLedger), the region-composed analytic plan, and the
+telemetry-gated canary gate — plus the two acceptance pins:
+
+* **Partition pin** — one of three clusters partitioned mid-roll for
+  20+ coordinator ticks: the global roll completes on the healthy
+  clusters, ZERO global-budget violations, ZERO writes to the
+  partitioned cluster; on heal the cluster resumes via the engine's
+  adoption pass with no repeated node transitions — the transition
+  multiset matches an unpartitioned control run exactly.
+* **Canary pin** — an injected 25%-slow regression holds promotion with
+  the ``CanaryHeld`` condition + Warning event carrying the canary
+  roll's trace id (0 false holds in a healthy control run), and a
+  coordinator crash/restart during the soak re-adopts with ZERO writes
+  and a soak clock that survives the restart.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import Counter
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    FederationCanarySpec,
+    FederationClusterSpec,
+    FederationSpec,
+    IntOrString,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.federation import (
+    CanaryGate,
+    ClusterHealth,
+    ClusterRegistry,
+    FederationCoordinator,
+    FederationStateStore,
+    GlobalBudgetLedger,
+    ensure_federation_kind,
+    plan_federated,
+)
+from k8s_operator_libs_tpu.federation.canary import (
+    HELD,
+    PENDING,
+    PROMOTE,
+    SOAKING,
+)
+from k8s_operator_libs_tpu.federation.coordinator import (
+    HELD_REASON_KEY,
+    HELD_TRACE_KEY,
+    PHASE_DONE,
+    PHASE_HELD,
+    PHASE_KEY,
+    PHASE_PROMOTED,
+    PHASE_SOAKING,
+    SOAK_KEY,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.faults import FaultSchedule
+from k8s_operator_libs_tpu.k8s.leader import (
+    LEASE_GROUP,
+    LEASE_PLURAL,
+    LEASE_VERSION,
+    ensure_lease_kind,
+)
+from k8s_operator_libs_tpu.k8s.retry import (
+    CircuitBreaker,
+    ResilientClient,
+    RetryPolicy,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.sharded import BudgetLedger, LedgerError
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture, state_of
+
+KEYS = UpgradeKeys()
+
+# Stats keys that mutate the fake store — the partition pin's "zero
+# writes" is asserted over exactly these.
+_MUTATING_PREFIXES = (
+    "patch",
+    "create",
+    "update",
+    "delete",
+    "evict",
+    "set_",
+)
+
+
+def mutating_stats(fake: FakeCluster) -> dict:
+    return {
+        k: v
+        for k, v in fake.stats.items()
+        if k.startswith(_MUTATING_PREFIXES)
+    }
+
+
+class Member:
+    """One federated member cluster: FakeCluster + fixture fleet +
+    breaker-wrapped client + a real engine, with a transition recorder
+    for the write-parity pin."""
+
+    def __init__(self, name: str, region: str, slices: int = 3, hosts: int = 2):
+        self.name = name
+        self.region = region
+        self.fake = FakeCluster()
+        self.schedule: FaultSchedule | None = None
+        self.fixture = ClusterFixture(self.fake, keys=KEYS)
+        self.ds = self.fixture.daemon_set()
+        self.nodes = []
+        for i in range(slices):
+            slice_nodes = self.fixture.tpu_slice(f"{name}-s{i}", hosts=hosts)
+            self.nodes.extend(slice_nodes)
+            for node in slice_nodes:
+                self.fixture.driver_pod(node, self.ds)
+        # reset_timeout_s=0: every call while open is a half-open probe,
+        # so healing needs no wall-clock wait in tests.
+        self.client = ResilientClient(
+            self.fake,
+            retry_policy=RetryPolicy(
+                max_attempts=2,
+                base_backoff_s=0.001,
+                max_backoff_s=0.002,
+                jitter=0.0,
+            ),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.0),
+        )
+        self.mgr = ClusterUpgradeStateManager(
+            self.client, keys=KEYS, poll_interval_s=0.005, poll_timeout_s=2.0
+        )
+        # plan_federated duck-types its entries on manager/frozen_groups,
+        # so the harness doubles as a plan entry.
+        self.manager = self.mgr
+        self.frozen_groups: dict = {}
+        # (node, new_state) per group transition — the parity evidence.
+        self.transitions: list[tuple[str, str]] = []
+        self.mgr.provider.add_transition_observer(self._observe)
+
+    def _observe(self, nodes, new_state) -> None:
+        for node in nodes:
+            self.transitions.append((node.name, new_state.value))
+
+    def start_roll(self, hash_suffix: str = "hash-2", revision: int = 2):
+        self.fixture.bump_daemon_set_template(self.ds, hash_suffix, revision)
+        self.fixture.auto_recreate_driver_pods(self.ds, hash_suffix)
+
+    def partition(self) -> None:
+        """Every API verb on this cluster fails like a dead WAN link."""
+        self.schedule = FaultSchedule().server_error("")
+        self.fake.fault_schedule = self.schedule
+
+    def heal(self) -> None:
+        if self.schedule is not None:
+            self.schedule.clear()
+        self.fake.fault_schedule = None
+        self.schedule = None
+
+    def all_done(self) -> bool:
+        return all(
+            state_of(self.fake, KEYS, n.name) == UpgradeState.DONE.value
+            for n in self.nodes
+        )
+
+
+def make_policy(clusters, canary_region="r1", soak_second=0, global_max="50%"):
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("50%"),
+        drain_spec=DrainSpec(enable=False),
+        federation=FederationSpec(
+            enable=True,
+            clusters=[
+                FederationClusterSpec(name=n, region=r) for n, r in clusters
+            ],
+            canary=FederationCanarySpec(
+                region=canary_region, soak_second=soak_second
+            ),
+            max_unavailable=IntOrString(global_max),
+        ),
+    )
+
+
+def make_federation(
+    members, canary_region="r1", soak_second=0, global_max="50%", term=1
+):
+    policy = make_policy(
+        [(m.name, m.region) for m in members],
+        canary_region=canary_region,
+        soak_second=soak_second,
+        global_max=global_max,
+    )
+    policy.validate()
+    registry = ClusterRegistry(
+        degraded_after=1, partitioned_after=2, heal_probes=1
+    )
+    for m in members:
+        registry.add(m.name, m.region, m.client, manager=m.mgr)
+    store_client = FakeCluster()
+    ensure_federation_kind(store_client)
+    store = FederationStateStore(store_client, NAMESPACE)
+    coord = FederationCoordinator(
+        registry,
+        policy,
+        NAMESPACE,
+        DRIVER_LABELS,
+        store,
+        identity="fed-coordinator",
+        term=term,
+        async_wait_s=10.0,
+    )
+    return coord, registry, store, store_client
+
+
+def run_until(coord, cond, max_ticks=150):
+    """Tick the coordinator until ``cond(summary)`` or fail."""
+    for i in range(max_ticks):
+        summary = coord.tick()
+        if cond(summary):
+            return summary, i + 1
+    raise AssertionError(
+        f"condition not reached in {max_ticks} ticks; "
+        f"last phase {coord.phase}, status {coord.status()}"
+    )
+
+
+def events_by_reason(store_client, reason):
+    return [
+        e
+        for e in store_client.list_events(NAMESPACE)
+        if e.get("reason") == reason
+    ]
+
+
+# --- registry: the health ladder -------------------------------------------
+
+
+class TestClusterRegistry:
+    def test_failure_streak_climbs_the_ladder_and_never_skips_down(self):
+        reg = ClusterRegistry(
+            degraded_after=2, partitioned_after=4, heal_probes=2
+        )
+        reg.add("a", "r1", FakeCluster())
+        assert reg.health("a") is ClusterHealth.REACHABLE
+        reg.observe_failure("a", "timeout")
+        assert reg.health("a") is ClusterHealth.REACHABLE  # streak 1 < 2
+        reg.observe_failure("a", "timeout")
+        assert reg.health("a") is ClusterHealth.DEGRADED
+        reg.observe_failure("a", "timeout")
+        assert reg.health("a") is ClusterHealth.DEGRADED  # streak 3 < 4
+        reg.observe_failure("a", "timeout")
+        assert reg.health("a") is ClusterHealth.PARTITIONED
+        assert reg.partitioned() == ["a"]
+        assert reg.stats["partitions"] == 1
+        # Heal hysteresis: heal_probes clean probes → Degraded, one more
+        # → Reachable.  A single clean probe cannot whipsaw the freeze.
+        reg.observe_success("a")
+        assert reg.health("a") is ClusterHealth.PARTITIONED
+        reg.observe_success("a")
+        assert reg.health("a") is ClusterHealth.DEGRADED
+        reg.observe_success("a")
+        assert reg.health("a") is ClusterHealth.REACHABLE
+        assert reg.stats["heals"] == 1
+        # The transition log shows the full ladder, no skips.
+        ladder = [(t[2], t[3]) for t in reg.transitions]
+        assert ladder == [
+            ("Reachable", "Degraded"),
+            ("Degraded", "Partitioned"),
+            ("Partitioned", "Degraded"),
+            ("Degraded", "Reachable"),
+        ]
+
+    def test_one_failure_never_partitions_but_open_breaker_does(self):
+        m = Member("a", "r1", slices=1, hosts=1)
+        # A long reset timeout so an open breaker fast-fails instead of
+        # admitting a half-open probe.
+        m.client.breaker.reset_timeout_s = 999.0
+        reg = ClusterRegistry(degraded_after=1, partitioned_after=3)
+        reg.add("a", "r1", m.client, manager=m.mgr)
+        m.partition()
+        # First probe: transport error, retried, soft failure → Degraded.
+        assert reg.probe("a") is ClusterHealth.DEGRADED
+        # Breaker is now open (threshold 2 hit by retries); the next
+        # probe fast-fails on CircuitOpenError → hard escalation straight
+        # to Partitioned, before the soft streak could get there.
+        assert reg.probe("a") is ClusterHealth.PARTITIONED
+        assert "circuit open" in reg.detail("a")
+
+    def test_probe_succeeds_end_to_end_on_healthy_cluster(self):
+        m = Member("a", "r1", slices=1, hosts=1)
+        reg = ClusterRegistry()
+        reg.add("a", "r1", m.client, manager=m.mgr)
+        assert reg.probe("a") is ClusterHealth.REACHABLE
+        assert reg.stats["probes"] == 1
+        assert reg.stats["probe_failures"] == 0
+
+    def test_lease_staleness_uses_the_observer_clock(self):
+        clock = {"t": 0.0}
+        client = FakeCluster()
+        ensure_lease_kind(client)
+        client.create_custom_object(
+            LEASE_GROUP,
+            LEASE_VERSION,
+            LEASE_PLURAL,
+            NAMESPACE,
+            {
+                "metadata": {"name": "upgrade-controller"},
+                "spec": {
+                    "holderIdentity": "ctl-1",
+                    "renewTime": "2026-01-01T00:00:00.000000Z",
+                    "leaseDurationSeconds": 5,
+                },
+            },
+        )
+        reg = ClusterRegistry(
+            degraded_after=1,
+            partitioned_after=2,
+            heal_probes=1,
+            mono_clock=lambda: clock["t"],
+        )
+        reg.add(
+            "a",
+            "r1",
+            client,
+            lease_namespace=NAMESPACE,
+            lease_name="upgrade-controller",
+        )
+        # First observation records the (holder, renewTime) pair — fresh
+        # regardless of what wall-clock time the stamp claims.
+        assert reg.probe("a") is ClusterHealth.REACHABLE
+        # No renewal observed for > leaseDurationSeconds of OUR clock.
+        clock["t"] = 6.0
+        assert reg.probe("a") is ClusterHealth.DEGRADED
+        assert "stale" in reg.detail("a")
+        clock["t"] = 12.0
+        assert reg.probe("a") is ClusterHealth.PARTITIONED
+        # The member controller renews: pair changes, probe goes clean,
+        # and the heal ladder steps down with hysteresis.
+        lease = client.get_custom_object(
+            LEASE_GROUP,
+            LEASE_VERSION,
+            LEASE_PLURAL,
+            NAMESPACE,
+            "upgrade-controller",
+        )
+        lease["spec"]["renewTime"] = "2026-01-01T00:00:07.000000Z"
+        client.update_custom_object(
+            LEASE_GROUP, LEASE_VERSION, LEASE_PLURAL, NAMESPACE, lease
+        )
+        assert reg.probe("a") is ClusterHealth.DEGRADED
+        assert reg.probe("a") is ClusterHealth.REACHABLE
+
+
+# --- global budget hierarchy ------------------------------------------------
+
+
+class TestGlobalBudgetLedger:
+    def test_global_cap_gates_across_clusters(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=9, max_unavailable=3)
+        assert g.try_claim("a", "s0", 2)
+        assert g.try_claim("b", "s1", 1)
+        # 3/3 used: any further claim — from ANY cluster — is denied.
+        assert not g.try_claim("c", "s2", 1)
+        assert not g.can_claim("a", "s3", 1)
+        assert g.denials >= 1
+        g.release("a", "s0")
+        assert g.try_claim("c", "s2", 2)
+        assert g.unavailable_used() == 3
+        assert g.violations == 0
+
+    def test_per_cluster_caps_and_parallel(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=12, max_unavailable=8, max_parallel=3)
+        g.configure_clusters({"a": (2, 1)})
+        assert g.try_claim("a", "s0", 2)
+        # Cluster cap: a is at 2/2 units and 1/1 parallel.
+        assert not g.try_claim("a", "s1", 1)
+        assert g.try_claim("b", "s2", 2)
+        assert g.try_claim("b", "s3", 2)
+        # Global parallel cap (3) now binds.
+        assert not g.try_claim("c", "s4", 1)
+        assert g.parallel_used() == 3
+        assert g.cluster_used("a") == 2
+
+    def test_forced_charge_counts_but_never_violates(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=4, max_unavailable=2)
+        assert g.try_claim("a", "s0", 2)
+        # An already-unavailable group is a fact: force records it past
+        # the cap (so everyone sees it) without counting a violation.
+        assert g.try_claim("b", "s1", 2, force=True)
+        assert g.unavailable_used() == 4
+        assert g.forced_over_cap == 1
+        assert g.violations == 0
+        # And the reservation blocks every later non-forced claim.
+        assert not g.try_claim("c", "s2", 1)
+
+    def test_sync_cluster_replaces_only_that_clusters_slice(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=9, max_unavailable=9)
+        g.try_claim("a", "s0", 2)
+        g.try_claim("b", "s1", 1)
+        # a resyncs to a different charge set; b — possibly partitioned —
+        # keeps its fail-static reservation untouched.
+        g.sync_cluster("a", {"s5": 1}, total_units=3)
+        assert g.cluster_charges("a") == {"s5": 1}
+        assert g.cluster_charges("b") == {"s1": 1}
+        snap = g.snapshot()
+        assert snap["perCluster"] == {"a": 1, "b": 1}
+        assert snap["clusterUnits"] == {"a": 3}
+
+    def test_member_ledger_admission_is_global_and_cluster_and_pool(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=6, max_unavailable=2)
+        a, b = BudgetLedger(), BudgetLedger()
+        for ledger, name in ((a, "a"), (b, "b")):
+            ledger.parent = g
+            ledger.cluster_name = name
+            ledger.configure(
+                total_units=3, max_parallel=0, max_unavailable=3, unit="slice"
+            )
+        # Local caps would admit 3 in a alone; the global cap (2) bites
+        # first and b's usage counts against a's admission.
+        assert a.try_claim("a-s0", 1)
+        assert b.try_claim("b-s0", 1)
+        assert not a.try_claim("a-s1", 1)  # global 2/2
+        assert not a.can_claim("a-s1", 1)
+        # Idempotent re-claim of a held charge stays free.
+        assert a.try_claim("a-s0", 1)
+        assert g.unavailable_used() == 2
+        # Release propagates: the freed global unit admits b's next.
+        a.release("a-s0")
+        assert not g.holds("a", "a-s0")
+        assert b.try_claim("b-s1", 1)
+
+    def test_reclaim_force_recharges_a_rebaselined_parent(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=6, max_unavailable=6)
+        a = BudgetLedger()
+        a.parent = g
+        a.cluster_name = "a"
+        a.configure(total_units=3, max_parallel=0, max_unavailable=3, unit="slice")
+        assert a.try_claim("s0", 2)
+        # The parent loses the charge (e.g. an empty resync while the
+        # group stayed in flight locally) ...
+        g.sync_cluster("a", {})
+        assert g.cluster_used("a") == 0
+        # ... and the group's own idempotent re-claim restores it.
+        assert a.try_claim("s0", 2)
+        assert g.cluster_used("a") == 2
+
+
+class TestLedgerGuards:
+    def test_negative_charge_raises_everywhere(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=4, max_unavailable=4)
+        with pytest.raises(LedgerError):
+            g.try_claim("a", "s0", -1)
+        with pytest.raises(LedgerError):
+            g.can_claim("a", "s0", -1)
+        with pytest.raises(LedgerError):
+            g.sync_cluster("a", {"s0": -2})
+        local = BudgetLedger()
+        with pytest.raises(LedgerError):
+            local.try_claim("s0", -1)
+        with pytest.raises(LedgerError):
+            local.can_claim("s0", -1)
+
+    def test_global_double_release_always_raises(self):
+        g = GlobalBudgetLedger()
+        g.configure(total_units=4, max_unavailable=4)
+        g.try_claim("a", "s0", 1)
+        g.release("a", "s0")
+        with pytest.raises(LedgerError):
+            g.release("a", "s0")
+        with pytest.raises(LedgerError):
+            g.release("b", "never-claimed")
+
+    def test_local_double_release_is_tolerant_unless_strict(self):
+        ledger = BudgetLedger()
+        ledger.configure(
+            total_units=4, max_parallel=0, max_unavailable=4, unit="node"
+        )
+        ledger.try_claim("s0", 1)
+        ledger.release("s0")
+        ledger.release("s0")  # engine's idempotent "ensure free": no-op
+        ledger.strict_release = True
+        with pytest.raises(LedgerError):
+            ledger.release("s0")
+
+    def test_child_filters_noop_releases_from_the_strict_parent(self):
+        """The engine releases unconditionally on several exit paths; the
+        cluster ledger must swallow those no-ops rather than tripping the
+        global ledger's strict double-release guard."""
+        g = GlobalBudgetLedger()
+        g.configure(total_units=4, max_unavailable=4)
+        a = BudgetLedger()
+        a.parent = g
+        a.cluster_name = "a"
+        a.try_claim("s0", 1)
+        a.release("s0")
+        a.release("s0")  # no local charge → never reaches the parent
+        assert g.unavailable_used() == 0
+
+    def test_randomized_reservations_never_exceed_capacity(self):
+        """Property-style guard: under any interleaving of claims and
+        releases across three member ledgers, non-forced reservations
+        stay under every cap and the parent's view equals the sum of the
+        children's."""
+        rng = random.Random(20260807)
+        g = GlobalBudgetLedger()
+        g.configure(total_units=30, max_unavailable=7, max_parallel=5)
+        children = []
+        for name in ("a", "b", "c"):
+            child = BudgetLedger()
+            child.parent = g
+            child.cluster_name = name
+            child.configure(
+                total_units=10, max_parallel=3, max_unavailable=4, unit="node"
+            )
+            children.append(child)
+        held: set[tuple[int, str]] = set()
+        for step in range(600):
+            idx = rng.randrange(3)
+            child = children[idx]
+            gid = f"g{rng.randrange(6)}"
+            if rng.random() < 0.55:
+                cost = rng.randrange(0, 4)
+                granted = child.try_claim(gid, cost)
+                if granted:
+                    held.add((idx, gid))
+            else:
+                child.release(gid)
+                held.discard((idx, gid))
+            # Invariants, every step:
+            local_sum = sum(
+                sum(c.snapshot()["charges"].values()) for c in children
+            )
+            assert g.unavailable_used() == local_sum
+            assert g.unavailable_used() <= 7
+            assert g.parallel_used() <= 5
+            for c, name in zip(children, ("a", "b", "c")):
+                snap = c.snapshot()
+                assert sum(snap["charges"].values()) <= 4
+                assert len(snap["charges"]) <= 3
+                assert g.cluster_used(name) == sum(snap["charges"].values())
+        assert g.violations == 0
+
+
+# --- federated plan composition --------------------------------------------
+
+
+class TestFederatedPlan:
+    def test_regions_compose_canary_first_with_soak_gap(self):
+        a = Member("a", "r1", slices=2, hosts=2)
+        b = Member("b", "r2", slices=2, hosts=2)
+        for m in (a, b):
+            m.start_roll()
+        policy = make_policy(
+            [("a", "r1"), ("b", "r2")], canary_region="r1", soak_second=60
+        )
+        entries = []
+        for m in (a, b):
+            state = m.mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+            entries.append((m, state, ClusterHealth.REACHABLE))
+        fed = plan_federated(
+            entries, policy, canary_region="r1", soak_s=60.0, now=1000.0
+        )
+        assert fed.regions == ["r1", "r2"]
+        ca = fed.cluster_plan("a")
+        cb = fed.cluster_plan("b")
+        assert ca.start_offset_s == 0.0
+        # The follower region starts after the canary's projected end
+        # plus the full soak.
+        assert cb.start_offset_s == pytest.approx(
+            ca.plan.projected_duration_s + 60.0
+        )
+        assert fed.projected_duration_s >= cb.start_offset_s
+        assert fed.pending_groups == ca.plan.pending_groups + cb.plan.pending_groups
+        assert "canary=r1" in fed.render()
+
+    def test_partitioned_cluster_is_fail_static_in_the_plan(self):
+        a = Member("a", "r1", slices=2, hosts=2)
+        b = Member("b", "r2", slices=2, hosts=2)
+        a.start_roll()
+        b.frozen_groups = {"b-s0": 1}
+        policy = make_policy([("a", "r1"), ("b", "r2")])
+        state_a = a.mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        fed = plan_federated(
+            [
+                (a, state_a, ClusterHealth.REACHABLE),
+                (b, None, ClusterHealth.PARTITIONED),
+            ],
+            policy,
+            canary_region="r1",
+            now=1000.0,
+        )
+        cb = fed.cluster_plan("b")
+        assert cb.plan is None
+        assert cb.health == "Partitioned"
+        assert cb.frozen_groups == {"b-s0": 1}
+        rendered = fed.render()
+        assert "fail-static" in rendered
+        assert "budget reserved" in rendered
+        # The dict surface mirrors it for the CLI/CI.
+        as_dict = fed.to_dict()
+        bd = [c for c in as_dict["clusters"] if c["cluster"] == "b"][0]
+        assert bd["plan"] is None
+        assert bd["frozenGroups"] == {"b-s0": 1}
+
+
+# --- canary gate ------------------------------------------------------------
+
+
+class _StubPlane:
+    def __init__(self, fresh=None, broken=False):
+        self.fresh = list(fresh or [])
+        self.broken = broken
+
+    def recompute(self):
+        if self.broken:
+            raise RuntimeError("ring parse exploded")
+
+    def new_confirmations(self):
+        out, self.fresh = self.fresh, []
+        return out
+
+
+class TestCanaryGate:
+    def test_soak_clock_counts_down_to_promote(self):
+        clock = {"t": 100.0}
+        gate = CanaryGate(
+            10.0, mono_clock=lambda: clock["t"], epoch_clock=lambda: 5000.0
+        )
+        assert gate.evaluate().phase == PENDING
+        assert gate.begin_soak()
+        assert not gate.begin_soak()  # idempotent: one persisted epoch
+        assert gate.soak_started_epoch == 5000.0
+        clock["t"] = 104.0
+        verdict = gate.evaluate()
+        assert verdict.phase == SOAKING
+        assert verdict.soak_remaining_s == pytest.approx(6.0)
+        clock["t"] = 110.0
+        assert gate.evaluate().phase == PROMOTE
+
+    def test_confirmation_latches_a_hold_until_cleared(self):
+        gate = CanaryGate(0.0)
+        gate.begin_soak()
+        fresh = gate.observe_plane(
+            _StubPlane(
+                fresh=[
+                    {
+                        "node": "a-s0-w0",
+                        "worstStat": "tflops",
+                        "z": -8.1,
+                        "score": 9.0,
+                        "streak": 3,
+                    }
+                ]
+            ),
+            trace_id="trace-123",
+        )
+        assert len(fresh) == 1
+        verdict = gate.evaluate()
+        assert verdict.phase == HELD
+        assert "a-s0-w0" in verdict.reason
+        assert verdict.trace_id == "trace-123"
+        assert gate.holds_total == 1
+        # A later clean reading does NOT unlatch: only the operator does.
+        gate.observe_plane(_StubPlane())
+        assert gate.evaluate().phase == HELD
+        gate.clear_hold()
+        assert gate.evaluate().phase == PROMOTE
+
+    def test_broken_plane_reading_never_promotes_and_never_holds(self):
+        gate = CanaryGate(3600.0)
+        gate.begin_soak()
+        assert gate.observe_plane(_StubPlane(broken=True)) == []
+        assert gate.evaluate().phase == SOAKING
+        assert gate.holds_total == 0
+
+    def test_adopt_soak_preserves_elapsed_time_across_restart(self):
+        gate = CanaryGate(10.0)
+        # Persisted anchor says the soak started 7s ago: the restarted
+        # gate resumes AT 7s elapsed, not zero.
+        gate.adopt_soak(1000.0, now_epoch=1007.0)
+        verdict = gate.evaluate()
+        assert verdict.phase == SOAKING
+        assert verdict.soak_remaining_s == pytest.approx(3.0, abs=0.2)
+        # A skewed FUTURE stamp clamps to zero elapsed (soak can only
+        # lengthen across a crash, never shorten).
+        gate2 = CanaryGate(10.0)
+        gate2.adopt_soak(2000.0, now_epoch=1000.0)
+        assert gate2.evaluate().soak_remaining_s == pytest.approx(10.0, abs=0.2)
+
+
+# --- durable store ----------------------------------------------------------
+
+
+class TestFederationStateStore:
+    def test_save_is_only_on_change(self):
+        client = FakeCluster()
+        ensure_federation_kind(client)
+        store = FederationStateStore(client, NAMESPACE)
+        assert store.load() == {}
+        assert store.save({PHASE_KEY: "canary"}) == 1  # create
+        assert store.save({PHASE_KEY: "canary"}) == 0  # unchanged: no write
+        assert store.save({PHASE_KEY: "soaking"}) == 1
+        assert store.load()[PHASE_KEY] == "soaking"
+        # None deletes; deleting an absent key is also write-free.
+        assert store.save({PHASE_KEY: None}) == 1
+        assert store.save({PHASE_KEY: None}) == 0
+        assert store.load() == {}
+        assert store.writes == 3
+
+
+# --- acceptance pin: partition fail-static ----------------------------------
+
+
+def _run_full_federation(members, **kw):
+    """Drive a federation to PHASE_DONE with no faults (the control run
+    for the parity pin).  Returns the coordinator."""
+    coord, _, _, _ = make_federation(members, **kw)
+    coord.adopt()
+    for m in members:
+        m.start_roll()
+    run_until(coord, lambda s: s["phase"] == PHASE_DONE, max_ticks=200)
+    return coord
+
+
+def test_partition_pin_fail_static_roll_completes_and_resumes():
+    """ISSUE acceptance: one of three clusters partitioned mid-roll for
+    20+ ticks → the global roll completes on the healthy clusters with
+    zero budget violations and zero writes to the partitioned cluster;
+    on heal the cluster resumes via adoption with no repeated node
+    transitions (write parity vs an unpartitioned control run)."""
+    a = Member("a", "r1")
+    b = Member("b", "r2")
+    c = Member("c", "r2")
+    coord, registry, store, store_client = make_federation(
+        [a, b, c], canary_region="r1", soak_second=0
+    )
+    coord.adopt()
+    for m in (a, b, c):
+        m.start_roll()
+
+    # Phase 1: canary region (a) rolls alone; b and c untouched.
+    b_writes_before_promo = dict(mutating_stats(b.fake))
+    run_until(coord, lambda s: s["phase"] == PHASE_PROMOTED, max_ticks=120)
+    assert a.all_done()
+    assert mutating_stats(b.fake) == b_writes_before_promo
+    assert b.transitions == []
+
+    # Phase 2: roll b and c until b has in-flight budget, then cut b off.
+    run_until(
+        coord,
+        lambda s: coord.global_ledger.cluster_used("b") > 0,
+        max_ticks=60,
+    )
+    b.partition()
+    summary, _ = run_until(
+        coord,
+        lambda s: "b" in s["skippedPartitioned"]
+        or registry.health("b") is ClusterHealth.PARTITIONED,
+        max_ticks=10,
+    )
+    assert registry.health("b") is ClusterHealth.PARTITIONED
+    frozen_units = coord.global_ledger.cluster_used("b")
+    assert frozen_units > 0  # fail-static: the charge stays reserved
+    assert registry.member("b").frozen_groups  # and is visible
+
+    # Phase 3: ≥20 ticks partitioned.  Healthy clusters converge; the
+    # frozen cluster takes ZERO writes.
+    b_stats = dict(mutating_stats(b.fake))
+    b_transitions = list(b.transitions)
+    cap = coord.global_ledger.max_unavailable
+    for _ in range(20):
+        summary = coord.tick()
+        assert summary["skippedPartitioned"] == ["b"]
+        assert mutating_stats(b.fake) == b_stats
+        assert coord.global_ledger.cluster_used("b") == frozen_units
+        assert coord.global_ledger.violations == 0
+        assert coord.global_ledger.unavailable_used() <= max(
+            cap, coord.global_ledger.max_unavailable
+        )
+    assert b.transitions == b_transitions  # the engine never ran on b
+    assert a.all_done() and c.all_done()
+    assert coord.phase == PHASE_PROMOTED  # not done: b is frozen
+    # Surfaces agree on the failure.
+    conditions = {c_["type"]: c_ for c_ in coord.conditions()}
+    assert conditions["Partitioned"]["status"] == "True"
+    assert "b" in conditions["Partitioned"]["message"]
+    assert events_by_reason(store_client, "ClusterPartitioned")
+    fed_plan = coord.plan(now=2000.0)
+    assert fed_plan.cluster_plan("b").plan is None
+    assert "fail-static" in fed_plan.render()
+
+    # Phase 4: heal.  b resumes via adoption and the roll completes.
+    b.heal()
+    run_until(coord, lambda s: s["phase"] == PHASE_DONE, max_ticks=120)
+    assert b.all_done()
+    assert coord.global_ledger.violations == 0
+    assert coord.global_ledger.cluster_used("b") == 0
+    assert events_by_reason(store_client, "ClusterHealed")
+    assert events_by_reason(store_client, "FederatedRollComplete")
+    conditions = {c_["type"]: c_ for c_ in coord.conditions()}
+    assert conditions["Partitioned"]["status"] == "False"
+    # Durable phase record: adopt-stamp create + soaking + promoted +
+    # done — and nothing else (only-on-change writes).
+    assert store.writes == 4
+    assert store.load()[PHASE_KEY] == PHASE_DONE
+
+    # No repeated node transitions across the partition/heal cycle ...
+    repeats = {k: n for k, n in Counter(b.transitions).items() if n > 1}
+    assert repeats == {}
+    # ... and write parity: the transition multiset matches a control
+    # run of the same fleet that never partitioned.
+    b2 = Member("b", "r2")  # same name → identical node names
+    _run_full_federation([Member("a2", "r1"), b2, Member("c2", "r2")])
+    assert Counter(b.transitions) == Counter(b2.transitions)
+
+
+# --- acceptance pin: canary hold + soak durability --------------------------
+
+
+def _seed_battery(member: Member, slow: str = "", factor: float = 0.75):
+    """One telemetry battery across the member's fleet; ``slow`` runs at
+    ``factor`` of nominal (0.75 = the injected 25% regression)."""
+    plane = member.mgr.telemetry_plane
+    for i, node in enumerate(member.nodes):
+        scale = 1.0 + 0.002 * (i % 5 - 2)
+        if node.name == slow:
+            scale *= factor
+        plane.ingest(
+            node.name,
+            {"tflops": 240.0 * scale, "battery_execute_ms": 40.0 / scale},
+            generation="tpu-v5p-slice",
+        )
+
+
+def test_canary_pin_regression_holds_promotion_with_trace():
+    """ISSUE acceptance: an injected 25%-slow node in the canary region
+    confirms through the telemetry plane during the soak → promotion
+    hard-stops with the CanaryHeld condition + Warning event carrying
+    the canary roll's trace id; follower regions take zero writes while
+    held; clearing the hold promotes."""
+    a = Member("a", "r1")
+    b = Member("b", "r2")
+    coord, registry, store, store_client = make_federation(
+        [a, b], canary_region="r1", soak_second=600
+    )
+    coord.adopt()
+    for m in (a, b):
+        m.start_roll()
+    run_until(coord, lambda s: s["phase"] == PHASE_SOAKING, max_ticks=120)
+    assert a.all_done()
+    slow_node = a.nodes[0].name
+    for _ in range(3):  # confirm_batteries consecutive slow batteries
+        _seed_battery(a, slow=slow_node)
+    b_stats = dict(mutating_stats(b.fake))
+    summary, _ = run_until(
+        coord, lambda s: s["phase"] == PHASE_HELD, max_ticks=5
+    )
+    # The hold is loud and attributable.
+    verdict = coord.gate.evaluate()
+    assert verdict.phase == HELD
+    assert slow_node in verdict.reason
+    assert verdict.trace_id  # the canary roll's trace id
+    conditions = {c_["type"]: c_ for c_ in coord.conditions()}
+    assert conditions["CanaryHeld"]["status"] == "True"
+    assert verdict.trace_id in conditions["CanaryHeld"]["message"]
+    held_events = events_by_reason(store_client, "CanaryHeld")
+    assert len(held_events) == 1
+    assert held_events[0]["type"] == "Warning"
+    assert verdict.trace_id in held_events[0]["message"]
+    # Durable: a restarted coordinator adopts the hold.
+    anno = store.load()
+    assert anno[PHASE_KEY] == PHASE_HELD
+    assert anno[HELD_TRACE_KEY] == verdict.trace_id
+    # Follower region is frozen out while held (held keeps canary passes
+    # running, so only assert NO b writes and NO b transitions).
+    coord.tick()
+    assert mutating_stats(b.fake) == b_stats
+    assert b.transitions == []
+    assert coord.phase == PHASE_HELD
+    # Operator clears the hold; with the soak long gone stale we shrink
+    # it to zero so the clean gate promotes immediately.
+    coord.gate.clear_hold()
+    coord.gate.soak_s = 0.0
+    coord.phase = PHASE_SOAKING
+    run_until(coord, lambda s: s["phase"] == PHASE_DONE, max_ticks=150)
+    assert b.all_done()
+
+
+def test_canary_pin_healthy_control_run_never_holds():
+    """The dual of the regression pin: healthy telemetry all the way
+    through must produce ZERO false holds."""
+    a = Member("a", "r1")
+    b = Member("b", "r2")
+    coord, _, _, store_client = make_federation(
+        [a, b], canary_region="r1", soak_second=0
+    )
+    coord.adopt()
+    for m in (a, b):
+        m.start_roll()
+    # Healthy batteries flow the whole roll.
+    for _ in range(4):
+        _seed_battery(a)
+    run_until(coord, lambda s: s["phase"] == PHASE_DONE, max_ticks=200)
+    assert coord.gate.holds_total == 0
+    assert events_by_reason(store_client, "CanaryHeld") == []
+    assert events_by_reason(store_client, "CanaryPromoted")
+
+
+def test_canary_pin_coordinator_restart_mid_soak_is_write_free():
+    """ISSUE acceptance: coordinator crash/restart during the soak —
+    the new incarnation re-adopts with ZERO writes (store and members)
+    and the soak clock resumes at its elapsed point (sub-soak sleeps on
+    both sides of the restart sum past the soak)."""
+    a = Member("a", "r1")
+    b = Member("b", "r2")
+    coord, registry, store, store_client = make_federation(
+        [a, b], canary_region="r1", soak_second=1
+    )
+    coord.adopt()
+    for m in (a, b):
+        m.start_roll()
+    run_until(coord, lambda s: s["phase"] == PHASE_SOAKING, max_ticks=120)
+    started_epoch = store.load()[SOAK_KEY]
+    time.sleep(0.6)  # first half of the soak, pre-crash
+
+    # Crash: a brand-new coordinator over the same registry + store,
+    # same identity/term (a restart, not a failover).
+    writes_before = {
+        "store": store_client.stats.get("update_custom_object", 0)
+        + store_client.stats.get("create_custom_object", 0),
+        "a": dict(mutating_stats(a.fake)),
+        "b": dict(mutating_stats(b.fake)),
+    }
+    coord2 = FederationCoordinator(
+        registry,
+        coord.policy,
+        NAMESPACE,
+        DRIVER_LABELS,
+        store,
+        identity="fed-coordinator",
+        term=1,
+    )
+    summary = coord2.adopt()
+    assert summary["phase"] == PHASE_SOAKING
+    assert summary["soakAdopted"] is True
+    assert summary["storeWrites"] == 0  # same stamp → no write
+    assert (
+        store_client.stats.get("update_custom_object", 0)
+        + store_client.stats.get("create_custom_object", 0)
+        == writes_before["store"]
+    )
+    # Member adoption repeated nothing: every durable stamp already set.
+    assert mutating_stats(a.fake) == writes_before["a"]
+    assert mutating_stats(b.fake) == writes_before["b"]
+    assert store.load()[SOAK_KEY] == started_epoch
+    # The soak clock SURVIVED: ~0.6s already elapsed, so remaining is
+    # well under the full soak.
+    verdict = coord2.gate.evaluate()
+    assert verdict.phase in (SOAKING, PROMOTE)
+    if verdict.phase == SOAKING:
+        assert verdict.soak_remaining_s < 0.55
+    time.sleep(0.5)  # second half, post-restart: 0.6 + 0.5 > 1s soak
+    run_until(coord2, lambda s: s["phase"] == PHASE_DONE, max_ticks=200)
+    assert b.all_done()
+    assert coord2.gate.holds_total == 0
+
+
+# --- coordinator surfaces ---------------------------------------------------
+
+
+class TestCoordinatorSurfaces:
+    def test_status_and_condition_timestamps(self):
+        a = Member("a", "r1", slices=1, hosts=1)
+        coord, _, _, _ = make_federation([a], soak_second=0)
+        coord.adopt()
+        coord.tick(now_epoch=1000.0)
+        st = coord.status()
+        assert st["canary"]["region"] == "r1"
+        assert st["clusters"]["a"]["health"] == "Reachable"
+        assert st["globalBudget"]["violations"] == 0
+        conds = {c_["type"]: c_ for c_ in st["conditions"]}
+        assert conds["Partitioned"]["status"] == "False"
+        assert conds["CanaryHeld"]["status"] == "False"
+        first_ts = conds["Partitioned"]["lastTransitionTime"]
+        # Unchanged status preserves lastTransitionTime across ticks.
+        coord.tick(now_epoch=5000.0)
+        conds2 = {c_["type"]: c_ for c_ in coord.conditions()}
+        assert conds2["Partitioned"]["lastTransitionTime"] == first_ts
+
+    def test_adopt_restores_held_phase(self):
+        a = Member("a", "r1", slices=1, hosts=1)
+        coord, _, store, _ = make_federation([a])
+        store.save(
+            {
+                PHASE_KEY: PHASE_HELD,
+                HELD_REASON_KEY: "telemetry regression: node n0",
+                HELD_TRACE_KEY: "trace-42",
+            }
+        )
+        coord.adopt()
+        assert coord.phase == PHASE_HELD
+        assert coord.gate.held is not None
+        verdict = coord.gate.evaluate()
+        assert verdict.phase == HELD
+        assert verdict.trace_id == "trace-42"
+
+    def test_metrics_families_and_status_render(self):
+        """observe_federation publishes the whole surface, and the
+        status CLI parses it back + renders the federation section —
+        the same exposition-text round trip the other surfaces pin."""
+        from k8s_operator_libs_tpu.metrics import PREFIX, UpgradeMetrics
+        from k8s_operator_libs_tpu.status import federation_health
+
+        a = Member("a", "r1", slices=1, hosts=1)
+        b = Member("b", "r2", slices=1, hosts=1)
+        coord, _, _, _ = make_federation([a, b], soak_second=0)
+        coord.adopt()
+        for m in (a, b):
+            m.start_roll()
+        b.partition()
+        run_until(coord, lambda s: s.get("skippedPartitioned") == ["b"])
+
+        metrics = UpgradeMetrics()
+        metrics.observe_federation(coord)
+        text = metrics.registry.render()
+        assert (
+            f'{PREFIX}_federation_cluster_health'
+            f'{{cluster="a",region="r1"}} 0' in text
+        )
+        assert (
+            f'{PREFIX}_federation_cluster_health'
+            f'{{cluster="b",region="r2"}} 2' in text
+        )
+        assert f"{PREFIX}_federation_partitions_total 1" in text
+        assert f"{PREFIX}_federation_budget_violations_total 0" in text
+        assert f'{PREFIX}_federation_phase{{phase="' in text
+        assert f"{PREFIX}_federation_store_writes_total" in text
+
+        parsed = federation_health("http://x/metrics", fetch=lambda _u: text)
+        assert parsed is not None
+        assert parsed["clusters"]["b"]["health"] == "Partitioned"
+        assert parsed["clusters"]["a"]["health"] == "Reachable"
+        assert parsed["partitions"] == 1
+        assert parsed["budgetViolations"] == 0
+
+        from k8s_operator_libs_tpu.status import render
+
+        out = render(
+            {
+                "totalManagedNodes": 2,
+                "totalManagedGroups": 2,
+                "upgradesInProgress": 0,
+                "upgradesPending": 0,
+                "upgradesDone": 0,
+                "upgradesFailed": 0,
+                "groups": [],
+                "federation": parsed,
+            }
+        )
+        assert "federation: phase" in out
+        assert "b (r2): Partitioned" in out
+
+        # A bare manager (no federation wiring) publishes nothing.
+        metrics2 = UpgradeMetrics()
+        metrics2.observe_federation(object())
+        assert "federation_cluster_health{" not in metrics2.registry.render()

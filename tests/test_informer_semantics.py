@@ -680,3 +680,73 @@ def test_informer_event_replay_is_idempotent_under_rv_guards():
         )
     )
     assert informer.get_node("rv-0") is not None
+
+
+def test_full_relist_preserves_telemetry_rings_and_trace_anchors(
+    small_cache_tier,
+):
+    """Watch-drop → 410 → full re-list parity: the durable per-node
+    telemetry rings and trace anchors must come back from the re-list
+    BYTE-IDENTICAL.  A re-list replaces cached objects wholesale; any
+    normalization, truncation, or re-serialization through the cache
+    path would corrupt the crash-durable records the engine — and the
+    federation canary — re-adopt from."""
+    from k8s_operator_libs_tpu.obs.telemetry import format_ring, parse_ring
+    from k8s_operator_libs_tpu.upgrade import UpgradeKeys
+
+    keys = UpgradeKeys()
+    store, client = small_cache_tier.store, small_cache_tier.client
+    rings = {}
+    for i in range(3):
+        ring = format_ring(
+            [
+                (1, 1000.125, {"tflops": 239.5 + i, "gbps": 978.25}),
+                (2, 1060.5, {"tflops": 240.0 + i, "gbps": 979.0}),
+            ]
+        )
+        anchor = f'{{"trace":"tr-{i:04x}","span":"roll/{i}","term":7}}'
+        rings[f"ring-{i}"] = (ring, anchor)
+        store.create_node(
+            make_node(
+                f"ring-{i}",
+                annotations={
+                    keys.telemetry_history_annotation: ring,
+                    keys.trace_annotation: anchor,
+                },
+            )
+        )
+    informer = Informer(client)
+    rv = informer.sync()
+    assert informer.fresh()
+
+    def snapshot():
+        out = {}
+        for n in informer.list_nodes():
+            if not n.name.startswith("ring-"):
+                continue
+            out[n.name] = (
+                n.metadata.annotations.get(keys.telemetry_history_annotation),
+                n.metadata.annotations.get(keys.trace_annotation),
+            )
+        return out
+
+    before = snapshot()
+    assert before == rings  # cache serves the exact stored bytes
+    # Age the resume point out of the 4-entry watch cache, then drop the
+    # stream: resume is impossible, the informer must 410 → re-list.
+    for i in range(12):
+        store.patch_node_labels("ring-0", {"gen": str(i)})
+    with pytest.raises(ExpiredError):
+        for ev in client.watch_events(["Node"], since_rv=rv):
+            informer.handle_event(ev)
+    informer.invalidate()
+    assert not informer.fresh()
+    informer.sync()
+    assert informer.fresh()
+    assert informer.stats["relists_410"] == 1
+    # Byte parity across the full re-list, and the parsed view agrees.
+    after = snapshot()
+    assert after == before
+    for name, (ring, _anchor) in after.items():
+        assert parse_ring(ring) == parse_ring(rings[name][0])
+        assert ring == rings[name][0]

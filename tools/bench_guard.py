@@ -197,6 +197,24 @@ TELEMETRY_STRAGGLER_FRACTION = 0.75
 TELEMETRY_ROLL_SLICES = 4
 TELEMETRY_ROLL_HOSTS = 4
 
+# Federation stage: the partition-tolerance pins.  Three 256-node
+# member clusters (64 slices x 4 hosts each) roll one global policy
+# through the FederationCoordinator; mid-roll one non-canary cluster
+# is partitioned (every API verb fails) for a 20-tick window.  Pins:
+# the coordinator must mark it skipped on every window tick, issue
+# ZERO mutating API verbs against it for the whole window, record
+# ZERO global-budget violations over the entire roll, and still
+# converge all three clusters to upgrade-done after the heal.  The
+# durable store must stay phase-proportional: its write count is
+# capped well below the tick count (state is persisted on phase
+# edges, never per tick).
+FED_N_CLUSTERS = 3
+FED_SLICES_PER_CLUSTER = 64
+FED_HOSTS_PER_SLICE = 4
+FED_PARTITION_TICKS = 20
+FED_STORE_WRITE_CEILING = 8
+FED_MAX_TICKS = 600
+
 
 def measure(
     slices: int = N_SLICES,
@@ -1725,6 +1743,205 @@ def measure_telemetry(
     }
 
 
+
+def measure_federation(
+    n_clusters: int = FED_N_CLUSTERS,
+    slices: int = FED_SLICES_PER_CLUSTER,
+    hosts: int = FED_HOSTS_PER_SLICE,
+    partition_ticks: int = FED_PARTITION_TICKS,
+) -> dict:
+    """Federated-roll measurement; returns the artifact dict.
+
+    One cluster per region past the canary; the canary region rolls
+    first, promotes on a zero-length soak, then cluster "b" loses its
+    WAN link for ``partition_ticks`` coordinator ticks while the rest
+    of the fleet keeps rolling.  The numbers this returns are exactly
+    the ones main() pins — see the FED_* constants."""
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        FederationCanarySpec,
+        FederationClusterSpec,
+        FederationSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.federation import (
+        ClusterRegistry,
+        FederationCoordinator,
+        FederationStateStore,
+        ensure_federation_kind,
+    )
+    from k8s_operator_libs_tpu.federation.coordinator import (
+        PHASE_DONE,
+        PHASE_PROMOTED,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.k8s.faults import FaultSchedule
+    from k8s_operator_libs_tpu.k8s.retry import (
+        CircuitBreaker,
+        ResilientClient,
+        RetryPolicy,
+    )
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    mutating = ("patch", "create", "update", "delete", "evict", "set_")
+
+    def _writes(cluster) -> int:
+        return int(
+            sum(
+                v
+                for k, v in cluster.stats.items()
+                if str(k).startswith(mutating)
+            )
+        )
+
+    members = {}
+    regions = {}
+    for idx in range(n_clusters):
+        name = chr(ord("a") + idx)
+        region = f"r{idx + 1}"
+        fake = FakeCluster()
+        fx = ClusterFixture(fake, keys=keys)
+        ds = fx.daemon_set()
+        nodes = []
+        for i in range(slices):
+            slice_nodes = fx.tpu_slice(f"{name}-s{i:02d}", hosts=hosts)
+            nodes.extend(slice_nodes)
+            for node in slice_nodes:
+                fx.driver_pod(node, ds)
+        fx.bump_daemon_set_template(ds, "hash-2", revision=2)
+        fx.auto_recreate_driver_pods(ds, "hash-2")
+        client = ResilientClient(
+            fake,
+            retry_policy=RetryPolicy(
+                max_attempts=2,
+                base_backoff_s=0.0005,
+                max_backoff_s=0.001,
+                jitter=0.0,
+            ),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.0),
+        )
+        mgr = ClusterUpgradeStateManager(
+            client, keys=keys, poll_interval_s=0.005, poll_timeout_s=2.0
+        )
+        members[name] = (fake, mgr, nodes)
+        regions[name] = region
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=16,
+        max_unavailable=IntOrString("50%"),
+        drain_spec=DrainSpec(enable=False),
+        federation=FederationSpec(
+            enable=True,
+            clusters=[
+                FederationClusterSpec(name=n, region=regions[n])
+                for n in members
+            ],
+            canary=FederationCanarySpec(region="r1", soak_second=0),
+            max_unavailable=IntOrString("50%"),
+        ),
+    )
+    policy.validate()
+
+    registry = ClusterRegistry(
+        degraded_after=1, partitioned_after=2, heal_probes=1
+    )
+    for name, (fake, mgr, _nodes) in members.items():
+        registry.add(name, regions[name], mgr.client, manager=mgr)
+    store_client = FakeCluster()
+    ensure_federation_kind(store_client)
+    store = FederationStateStore(store_client, NAMESPACE)
+    coord = FederationCoordinator(
+        registry,
+        policy,
+        NAMESPACE,
+        DRIVER_LABELS,
+        store,
+        identity="bench-fed",
+        term=1,
+        async_wait_s=10.0,
+    )
+
+    def _cluster_done(name) -> bool:
+        fake, _mgr, nodes = members[name]
+        return all(
+            fake.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            == UpgradeState.DONE.value
+            for n in nodes
+        )
+
+    target = members["b"][0]
+    ticks = 0
+    window_skips = 0
+    window_writes = -1
+    partitioned_at = -1
+    healed_at = -1
+    b_started_before_partition = False
+    while ticks < FED_MAX_TICKS:
+        summary = coord.tick()
+        ticks += 1
+        if partitioned_at < 0 and coord.phase in (
+            PHASE_PROMOTED,
+            PHASE_DONE,
+        ):
+            # Let the non-canary regions get genuinely mid-roll before
+            # cutting the link.
+            b_started_before_partition = any(
+                target.get_node(n.name, cached=False).labels.get(
+                    keys.state_label
+                )
+                for n in members["b"][2]
+            )
+            if b_started_before_partition and not _cluster_done("b"):
+                target.fault_schedule = FaultSchedule().server_error("")
+                writes_before = _writes(target)
+                partitioned_at = ticks
+        elif partitioned_at > 0 and healed_at < 0:
+            if "b" in (summary.get("skippedPartitioned") or []):
+                window_skips += 1
+            if ticks - partitioned_at >= partition_ticks:
+                window_writes = _writes(target) - writes_before
+                target.fault_schedule = None
+                healed_at = ticks
+        if coord.phase == PHASE_DONE and all(
+            _cluster_done(n) for n in members
+        ):
+            break
+
+    return {
+        "stage": "federation",
+        "clusters": n_clusters,
+        "nodes_per_cluster": slices * hosts,
+        "nodes": n_clusters * slices * hosts,
+        "ticks": ticks,
+        "converged": coord.phase == PHASE_DONE
+        and all(_cluster_done(n) for n in members),
+        "partition_started": b_started_before_partition
+        and partitioned_at > 0,
+        "partition_window_ticks": (
+            (healed_at - partitioned_at) if healed_at > 0 else -1
+        ),
+        "partition_window_skips": window_skips,
+        "partition_window_writes": window_writes,
+        "global_budget_violations": coord.global_ledger.violations,
+        "global_budget_denials": coord.global_ledger.denials,
+        "peak_global_unavailable": coord.global_ledger.peak_unavailable,
+        "store_writes": store.writes,
+        "partitions_detected": registry.stats.get("partitions", 0),
+        "heals": registry.stats.get("heals", 0),
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -2145,6 +2362,64 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (telemetry): {f}", file=sys.stderr)
+        return 1
+
+    federation = measure_federation()
+    failures = []
+    if not federation["partition_started"]:
+        failures.append(
+            "the partition window never opened mid-roll (cluster b "
+            "finished or never started before the link cut) — the "
+            "remaining pins would prove nothing"
+        )
+    if not federation["converged"]:
+        failures.append(
+            f"federated roll did not converge after "
+            f"{federation['ticks']} ticks (fail-static resume broken?)"
+        )
+    if federation["partition_window_writes"] != 0:
+        failures.append(
+            f"coordinator issued {federation['partition_window_writes']} "
+            "mutating API verb(s) against the partitioned cluster "
+            "during the window (must be exactly 0 — fail-static means "
+            "freeze, not retry)"
+        )
+    # Detection costs exactly one tick (probe failure -> Degraded,
+    # engine failure -> Partitioned within that same pass); every
+    # remaining window tick must report the cluster skipped.
+    if (
+        federation["partition_window_skips"]
+        < federation["partition_window_ticks"] - 1
+    ):
+        failures.append(
+            f"only {federation['partition_window_skips']}/"
+            f"{federation['partition_window_ticks']} window ticks "
+            "reported the partitioned cluster as skipped (at most one "
+            "detection tick is allowed)"
+        )
+    if federation["global_budget_violations"] != 0:
+        failures.append(
+            f"{federation['global_budget_violations']} global-budget "
+            "violation(s) (must be exactly 0 — a member charged past "
+            "the global cap)"
+        )
+    if federation["store_writes"] > FED_STORE_WRITE_CEILING:
+        failures.append(
+            f"durable store took {federation['store_writes']} writes "
+            f"over {federation['ticks']} ticks (ceiling "
+            f"{FED_STORE_WRITE_CEILING} — state must persist on phase "
+            "edges, never per tick)"
+        )
+    if federation["heals"] < 1:
+        failures.append(
+            "registry never recorded the heal (the ladder is stuck "
+            "in Partitioned)"
+        )
+    federation["ok"] = not failures
+    print(json.dumps(federation, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(f"bench-guard FAIL (federation): {f}", file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,167 @@
+"""Chaos battery runner — `make chaos` entrypoint.
+
+Runs each fault-injection battery as its own pytest process (so one
+battery's crash — segfault, hang past the per-battery timeout, fixture
+leak — cannot mask or poison the others), then prints a one-line-per-
+battery summary table and exits nonzero if ANY battery failed.
+
+The batteries, in dependency-light-to-heavy order:
+
+* ``test_fault_tolerance.py`` — retry ladder, circuit breaker (incl.
+  the concurrent half-open probe race), resilient client wiring.
+* ``test_node_faults.py``    — mid-roll hardware loss, slice
+  quarantine, eviction escalation.
+* ``test_chaos.py``          — full rolls through API fault schedules,
+  controller crash/adoption, fenced-writer abandonment.
+* ``test_fuzz_invariants.py``— seed-parameterized randomized rolls
+  with global invariant checks.
+* ``test_federation.py``     — cross-cluster partitions, fail-static
+  freeze/resume, canary holds, global budget hierarchy.
+
+``PYTHONHASHSEED`` is pinned to 0 for every battery: the fuzz
+scenarios are seed-parameterized already, so set iteration order is
+the one remaining source of cross-run variation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BATTERIES = [
+    "tests/test_fault_tolerance.py",
+    "tests/test_node_faults.py",
+    "tests/test_chaos.py",
+    "tests/test_fuzz_invariants.py",
+    "tests/test_federation.py",
+]
+
+# Per-battery wall-clock cap.  A hung battery (deadlocked half-open
+# probe, stuck poll loop) should fail ITS row, not wedge the target.
+BATTERY_TIMEOUT_S = 600
+
+_COUNT = re.compile(r"(\d+) (passed|failed|error|errors|skipped|xfailed)")
+
+
+def _tally(output: str) -> dict:
+    """Fold pytest's final summary line into {outcome: count}."""
+    counts: dict = {}
+    for line in reversed(output.splitlines()):
+        found = _COUNT.findall(line)
+        if found and ("passed" in line or "failed" in line or "error" in line):
+            for n, outcome in found:
+                counts[outcome.rstrip("s")] = int(n)
+            break
+    return counts
+
+
+def run_battery(path: str, extra_args: list) -> dict:
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = "0"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # No explicit -q: pyproject's addopts already passes one, and a
+    # second would stack to -qq, which drops the "N passed" summary
+    # line the table is built from.
+    cmd = [
+        sys.executable,
+        "-m",
+        "pytest",
+        "-p",
+        "no:cacheprovider",
+        path,
+        *extra_args,
+    ]
+    started = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=_ROOT,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=BATTERY_TIMEOUT_S,
+        )
+        rc = proc.returncode
+        output = proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        rc = -1
+        output = (exc.stdout or "") + (exc.stderr or "")
+        output += f"\nTIMEOUT after {BATTERY_TIMEOUT_S}s"
+    wall_s = time.monotonic() - started
+    counts = _tally(output)
+    return {
+        "battery": os.path.basename(path),
+        "rc": rc,
+        "wall_s": wall_s,
+        "passed": counts.get("passed", 0),
+        "failed": counts.get("failed", 0) + counts.get("error", 0),
+        "skipped": counts.get("skipped", 0),
+        "output": output,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "batteries",
+        nargs="*",
+        default=None,
+        help="battery files to run (default: the full ladder)",
+    )
+    parser.add_argument(
+        "-k",
+        dest="keyword",
+        default="",
+        help="pytest -k expression forwarded to every battery",
+    )
+    args = parser.parse_args(argv)
+    batteries = args.batteries or BATTERIES
+    extra = ["-k", args.keyword] if args.keyword else []
+
+    results = [run_battery(path, extra) for path in batteries]
+
+    width = max(len(r["battery"]) for r in results)
+    header = (
+        f"{'battery':<{width}}  {'verdict':<7}  {'passed':>6}  "
+        f"{'failed':>6}  {'skipped':>7}  {'wall':>7}"
+    )
+    print()
+    print(header)
+    print("-" * len(header))
+    any_failed = False
+    for r in results:
+        # rc 5 = "no tests collected" (e.g. -k matched nothing): not a
+        # failure of the battery itself.
+        ok = r["rc"] in (0, 5) and r["failed"] == 0
+        any_failed = any_failed or not ok
+        verdict = "ok" if ok else ("TIMEOUT" if r["rc"] == -1 else "FAIL")
+        print(
+            f"{r['battery']:<{width}}  {verdict:<7}  {r['passed']:>6}  "
+            f"{r['failed']:>6}  {r['skipped']:>7}  {r['wall_s']:>6.1f}s"
+        )
+    print("-" * len(header))
+    total_passed = sum(r["passed"] for r in results)
+    total_failed = sum(r["failed"] for r in results)
+    print(
+        f"{'total':<{width}}  {'FAIL' if any_failed else 'ok':<7}  "
+        f"{total_passed:>6}  {total_failed:>6}"
+    )
+    if any_failed:
+        # Replay the failing batteries' full output so the first
+        # failure is diagnosable straight from the CI log.
+        for r in results:
+            if r["rc"] not in (0, 5) or r["failed"]:
+                print(f"\n=== {r['battery']} (rc {r['rc']}) ===")
+                print(r["output"])
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
