@@ -1352,6 +1352,7 @@ def main() -> None:
     from bench_guard import (  # noqa: E402
         measure_elastic as measure_elastic_roll,
         measure_heterogeneous as measure_heterogeneous_roll,
+        measure_incremental as measure_incremental_reconcile,
         measure_packed_admission,
         measure_planner,
         measure_sharded as measure_sharded_reconcile,
@@ -1436,6 +1437,22 @@ def main() -> None:
     beat()
     log(f"tracing (overhead + attribution + black box): {tracing}")
 
+    # -- incremental O(delta) reconcile at 100k nodes (gated by
+    # `make bench-guard`) ----------------------------------------------------
+    # Materialized-view + COW-snapshot pins at fleet scale: idle ticks
+    # walk 0 pools at 0 API writes, one delta reconciles exactly 1 pool
+    # from the view (no build_state), snapshot construction does zero
+    # full-map deep copies, the full-resync view-vs-build_state audit
+    # reports 0 mismatches, and peak RSS stays under the bounded budget.
+    # Runs AFTER the timing-sensitive stages — the 100k fixture's ~2 GiB
+    # of heap churn would otherwise inflate their p99s — and the fleet
+    # build + seed resync dominate the ~2 min wall, so beat() brackets
+    # it to keep the stall monitor quiet.
+    beat()
+    incremental_100k = measure_incremental_reconcile()
+    beat()
+    log(f"incremental reconcile (100k-node O(delta)): {incremental_100k}")
+
     complete = seq_result["complete"]
     details = {
         "complete": complete,
@@ -1485,6 +1502,7 @@ def main() -> None:
         "failure_injection": failinj,
         "cached_reconcile": cached_reconcile,
         "sharded_reconcile": sharded_reconcile,
+        "incremental_100k": incremental_100k,
         "elastic_roll": {
             "accept": elastic_roll,
             "decline_fallback": elastic_fallback,
@@ -1576,6 +1594,18 @@ def main() -> None:
         "sharded_active_pools_walked": sharded_reconcile[
             "active_pools_walked"
         ],
+        "incremental_idle_pools_walked": incremental_100k[
+            "idle_pools_walked_total"
+        ],
+        "incremental_active_tick_s": incremental_100k["active_tick_s"],
+        "incremental_matview_hits": incremental_100k["matview_hits"],
+        "incremental_resync_diff_mismatches": incremental_100k[
+            "resync_diff_mismatches"
+        ],
+        "incremental_snapshot_build_s": incremental_100k[
+            "snapshot_build_s"
+        ],
+        "incremental_peak_rss_mib": incremental_100k["peak_rss_mib"],
         "write_hygiene_writes_per_transition": write_hygiene[
             "roll_writes_per_transition"
         ],

@@ -43,6 +43,8 @@ from k8s_operator_libs_tpu.k8s.objects import (
     ObjectMeta,
     Pod,
     deep_copy,
+    freeze,
+    is_frozen,
 )
 from k8s_operator_libs_tpu.k8s.selectors import (
     matches_labels,
@@ -122,6 +124,13 @@ class WatchEvent:
     rv: int = 0
 
 
+# Guards the one-time in-place freeze of a shared event snapshot.  This is
+# deliberately NOT the cluster lock: freezing happens on consumer threads,
+# and the only contention is two subscribers racing to freeze the same
+# event — never a consumer blocking an API writer.
+_freeze_lock = threading.Lock()
+
+
 class WatchSubscription:
     """Handle for one watch: iterate/get events, close to unsubscribe."""
 
@@ -136,16 +145,24 @@ class WatchSubscription:
         The queued event's snapshot is SHARED (with the event log, the
         cache-lag history, and every other subscriber) — publishing
         enqueues one object under the cluster lock instead of paying a
-        per-watcher deepcopy while holding it.  The isolating copy
-        happens here, on the consumer's thread: a consumer mutating its
-        event must not corrupt the shared views."""
+        per-watcher deepcopy while holding it.  Isolation is by
+        immutability, not copying: the first consumer to dequeue an
+        event freezes its snapshot in place (on the consumer's thread),
+        and every subscriber then shares that one frozen copy — reads
+        are free, mutation raises FrozenObjectError, and a consumer
+        that needs a private mutable object calls deep_copy() (which
+        thaws) exactly where it needs it."""
         try:
             ev = self._queue.get(timeout=timeout_s)
         except queue.Empty:
             return None
-        if ev.object is None:
+        obj = ev.object
+        if obj is None:
             return ev
-        return WatchEvent(ev.type, ev.kind, copy.deepcopy(ev.object), ev.rv)
+        if not is_frozen(obj):
+            with _freeze_lock:
+                ev.object = obj = freeze(ev.object)
+        return WatchEvent(ev.type, ev.kind, obj, ev.rv)
 
     def close(self) -> None:
         self._cluster._unwatch(self._entry)

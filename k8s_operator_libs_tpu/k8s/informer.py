@@ -68,7 +68,14 @@ class InformerSnapshot:
     """One coherent point-in-time view of the informer's stores, taken
     under a single lock acquisition: `build_state` resolves daemonsets,
     pods, and every pod's node from the SAME world state, with no
-    torn-read window between list calls."""
+    torn-read window between list calls.
+
+    ``shared=True`` marks a copy-on-write view: its maps are shallow and
+    reference the store's own objects, which is safe to hold across
+    later writes because every ingest path REPLACES store objects (never
+    mutates them in place) — but consumers must treat the view as
+    read-only and deep-copy any object before mutating it.  ``version``
+    stamps the store version the view was taken at."""
 
     def __init__(
         self,
@@ -76,11 +83,15 @@ class InformerSnapshot:
         pods: dict[tuple[str, str], Pod],
         daemon_sets: dict[tuple[str, str], DaemonSet],
         revisions: dict[tuple[str, str], ControllerRevision],
+        version: int = 0,
+        shared: bool = False,
     ) -> None:
         self.nodes = nodes
         self.pods = pods
         self.daemon_sets = daemon_sets
         self.revisions = revisions
+        self.version = version
+        self.shared = shared
 
     def get_node(self, name: str) -> Optional[Node]:
         return self.nodes.get(name)
@@ -173,6 +184,26 @@ class Informer:
         # put/delete; complex selector shapes fall back to a scan.
         self._pods_by_node: dict[str, set[tuple[str, str]]] = {}
         self._node_label_index: dict[tuple[str, str], set[str]] = {}
+        # Store version clock: the global counter advances on every
+        # effective mutation (sync swap, RV-accepted put, delete), the
+        # per-kind counters on mutations of that kind.  Snapshot views
+        # and the per-kind shallow-map caches key off these, so an
+        # unchanged store serves the SAME snapshot object again with
+        # zero copying.
+        self._version = 0
+        self._kind_versions: Counter = Counter()
+        self._snapshot_cache: Optional[InformerSnapshot] = None
+        self._kind_map_cache: dict[str, tuple[int, dict]] = {}
+        # Change listeners (materialized-view feed): called UNDER the
+        # informer lock as fn(kind, op, obj) with op in
+        # {"set", "delete", "reset"} after every effective store change.
+        # "set"/"delete" carry the store's own object (replace-on-write:
+        # safe to hold a reference, never mutate); "reset" (kind "*",
+        # obj None) signals a wholesale re-list — incremental consumers
+        # must drop their derived state.  Listeners must be O(1)-ish and
+        # must NEVER call back into the informer (lock ordering:
+        # informer -> listener, only).
+        self._listeners: list = []
         self.synced = False
         self._last_heard = 0.0
         self.stats: Counter = Counter()
@@ -271,6 +302,9 @@ class Informer:
                     self._node_label_index.setdefault(pair, set()).add(
                         name
                     )
+            for kind in DEFAULT_KINDS:
+                self._bump(kind)
+            self._fire("*", "reset", None)
             self.synced = True
             self._last_heard = time.monotonic()
             self.stats["lists"] += 1
@@ -318,6 +352,20 @@ class Informer:
             "ControllerRevision": self._revisions,
         }.get(kind)
 
+    def add_change_listener(self, fn) -> None:
+        """Register fn(kind, op, obj) for effective store changes (see
+        the ``_listeners`` contract in ``__init__``)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _bump(self, kind: str) -> None:
+        self._version += 1
+        self._kind_versions[kind] += 1
+
+    def _fire(self, kind: str, op: str, obj) -> None:
+        for fn in self._listeners:
+            fn(kind, op, obj)
+
     def _index_node(self, node: Node, add: bool) -> None:
         for pair in node.labels.items():
             bucket = self._node_label_index.setdefault(pair, set())
@@ -358,25 +406,30 @@ class Informer:
                 self._index_pod(current, add=False)
             self._index_pod(obj, add=True)
         store[key] = obj
+        self._bump(kind)
+        self._fire(kind, "set", obj)
         return True
 
-    def _delete(self, kind: str, obj, rv: int) -> None:
+    def _delete(self, kind: str, obj, rv: int) -> bool:
         store = self._store_for(kind)
         if store is None:
-            return
+            return False
         key = _key_of(kind, obj)
         current = store.get(key)
         if current is None:
-            return
+            return False
         # A DELETED delta older than the stored object means the object
         # was recreated and we already saw the newer incarnation.
         if rv and current.metadata.resource_version > rv:
-            return
+            return False
         if kind == "Node":
             self._index_node(current, add=False)
         elif kind == "Pod":
             self._index_pod(current, add=False)
         store.pop(key, None)
+        self._bump(kind)
+        self._fire(kind, "delete", current)
+        return True
 
     def handle_event(self, ev: Optional[WatchEvent]) -> None:
         """Apply one watch delta.  ``None`` (a stream heartbeat) and
@@ -498,41 +551,72 @@ class Informer:
                 and matches_selector(r.metadata.labels, label_selector)
             ]
 
+    def _shared_kind_map(self, kind: str) -> dict:
+        """Shallow copy of one kind's store, cached by that kind's
+        version: while the kind is unchanged every snapshot shares the
+        SAME map object; a change builds a fresh map and leaves the old
+        one untouched in any held snapshot.  Caller holds the lock."""
+        version = self._kind_versions[kind]
+        cached = self._kind_map_cache.get(kind)
+        if cached is not None and cached[0] == version:
+            self.stats["kind_map_reuses"] += 1
+            return cached[1]
+        shallow = dict(self._store_for(kind))
+        self._kind_map_cache[kind] = (version, shallow)
+        return shallow
+
     def snapshot(
         self, node_names: Optional[set[str]] = None
     ) -> InformerSnapshot:
-        """Deep-copied coherent view of every store, one lock hold.
+        """Copy-on-write coherent view of every store, one lock hold.
 
-        ``node_names`` (sharded dirty-set reconcile) scopes the copy to
+        No object is deep-copied: the view's maps are shallow and share
+        the store's objects, which stay point-in-time correct because
+        every ingest REPLACES objects rather than mutating them.  While
+        the store version is unchanged the same snapshot object is
+        returned again (zero allocation); consumers (`build_state`)
+        deep-copy only the objects they materialize into engine state.
+
+        ``node_names`` (sharded dirty-set reconcile) scopes the view to
         those nodes and the pods scheduled on them (via the per-node
-        index) — one pool's scoped `build_state` pays O(pool) copy cost,
-        not O(fleet).  DaemonSets and revisions are fleet-small and
-        always copied whole."""
+        index) — O(pool) map construction, with the fleet-small
+        DaemonSet/revision maps shared from the version-keyed cache."""
         with self._lock:
             if node_names is None:
-                nodes = {k: deep_copy(v) for k, v in self._nodes.items()}
-                pods = {k: deep_copy(v) for k, v in self._pods.items()}
-            else:
-                nodes = {
-                    name: deep_copy(self._nodes[name])
-                    for name in node_names
-                    if name in self._nodes
-                }
-                pods = {}
-                for name in node_names:
-                    for key in self._pods_by_node.get(name, ()):
-                        pod = self._pods.get(key)
-                        if pod is not None:
-                            pods[key] = deep_copy(pod)
+                snap = self._snapshot_cache
+                if snap is not None and snap.version == self._version:
+                    self.stats["snapshot_reuses"] += 1
+                    return snap
+                snap = InformerSnapshot(
+                    nodes=dict(self._nodes),
+                    pods=dict(self._pods),
+                    daemon_sets=self._shared_kind_map("DaemonSet"),
+                    revisions=self._shared_kind_map("ControllerRevision"),
+                    version=self._version,
+                    shared=True,
+                )
+                self._snapshot_cache = snap
+                self.stats["snapshot_builds"] += 1
+                return snap
+            nodes = {
+                name: self._nodes[name]
+                for name in node_names
+                if name in self._nodes
+            }
+            pods = {}
+            for name in node_names:
+                for key in self._pods_by_node.get(name, ()):
+                    pod = self._pods.get(key)
+                    if pod is not None:
+                        pods[key] = pod
+            self.stats["snapshot_scoped_builds"] += 1
             return InformerSnapshot(
                 nodes=nodes,
                 pods=pods,
-                daemon_sets={
-                    k: deep_copy(v) for k, v in self._daemon_sets.items()
-                },
-                revisions={
-                    k: deep_copy(v) for k, v in self._revisions.items()
-                },
+                daemon_sets=self._shared_kind_map("DaemonSet"),
+                revisions=self._shared_kind_map("ControllerRevision"),
+                version=self._version,
+                shared=True,
             )
 
     # -- standalone list-then-watch loop -------------------------------------

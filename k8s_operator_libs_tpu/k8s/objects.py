@@ -10,6 +10,7 @@ that slice as plain dataclasses.
 from __future__ import annotations
 
 import copy
+import dataclasses
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -228,3 +229,177 @@ class ControllerRevision:
 def deep_copy(obj):
     """DeepCopy analogue for any object in this model."""
     return copy.deepcopy(obj)
+
+
+# ---------------------------------------------------------------------------
+# Frozen object graphs — one shared copy per watch event.
+#
+# The watch fan-out used to hand every subscriber its own deepcopy of every
+# event object, built while holding the cluster-global lock.  Instead the
+# store's single ingest copy is frozen in place (recursively, containers and
+# dataclasses alike) and SHARED across all watchers: reads are unrestricted,
+# any mutation raises FrozenObjectError, and ``deep_copy`` on a frozen graph
+# thaws it back to plain mutable classes — so the one consumer that needs a
+# private mutable copy (the informer's RV-guarded ingest) pays for exactly
+# one copy, outside the cluster lock, instead of one per subscriber.
+# ---------------------------------------------------------------------------
+
+
+class FrozenObjectError(TypeError):
+    """Raised on any attempt to mutate a shared (frozen) watch-event object."""
+
+
+def _frozen_raise(self, *args, **kwargs):
+    raise FrozenObjectError(
+        "shared watch-event object is frozen; deep_copy() it before mutating"
+    )
+
+
+class FrozenDict(dict):
+    """dict that raises on mutation; deep_copy() thaws to a plain dict."""
+
+    __slots__ = ()
+
+    __setitem__ = _frozen_raise
+    __delitem__ = _frozen_raise
+    clear = _frozen_raise
+    pop = _frozen_raise
+    popitem = _frozen_raise
+    setdefault = _frozen_raise
+    update = _frozen_raise
+    __ior__ = _frozen_raise
+
+    def __deepcopy__(self, memo):
+        out: dict = {}
+        memo[id(self)] = out
+        for k, v in self.items():
+            out[copy.deepcopy(k, memo)] = copy.deepcopy(v, memo)
+        return out
+
+    def __copy__(self):
+        return dict(self)
+
+    def __reduce__(self):
+        return (dict, (dict(self),))
+
+
+class FrozenList(list):
+    """list that raises on mutation; deep_copy() thaws to a plain list."""
+
+    __slots__ = ()
+
+    __setitem__ = _frozen_raise
+    __delitem__ = _frozen_raise
+    __iadd__ = _frozen_raise
+    __imul__ = _frozen_raise
+    append = _frozen_raise
+    extend = _frozen_raise
+    insert = _frozen_raise
+    pop = _frozen_raise
+    remove = _frozen_raise
+    clear = _frozen_raise
+    sort = _frozen_raise
+    reverse = _frozen_raise
+
+    def __deepcopy__(self, memo):
+        out: list = []
+        memo[id(self)] = out
+        for v in self:
+            out.append(copy.deepcopy(v, memo))
+        return out
+
+    def __copy__(self):
+        return list(self)
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+_FROZEN_CLASSES: dict[type, type] = {}
+
+
+def _frozen_deepcopy(self, memo):
+    """Thaw: reconstruct the plain base class, deep-copying every field."""
+    base = type(self)._frozen_base_
+    out = base.__new__(base)
+    memo[id(self)] = out
+    for name, value in vars(self).items():
+        object.__setattr__(out, name, copy.deepcopy(value, memo))
+    return out
+
+
+def _frozen_eq(self, other):
+    """Field-wise equality tolerant of plain-vs-frozen class mismatch."""
+    base = type(self)._frozen_base_
+    if not isinstance(other, base):
+        return NotImplemented
+    for f in dataclasses.fields(base):
+        if getattr(self, f.name) != getattr(other, f.name):
+            return False
+    return True
+
+
+def _frozen_class_for(cls: type) -> type:
+    frozen = _FROZEN_CLASSES.get(cls)
+    if frozen is None:
+        frozen = type(
+            "Frozen" + cls.__name__,
+            (cls,),
+            {
+                "_frozen_base_": cls,
+                "__setattr__": _frozen_raise,
+                "__delattr__": _frozen_raise,
+                "__deepcopy__": _frozen_deepcopy,
+                "__eq__": _frozen_eq,
+                "__hash__": None,
+            },
+        )
+        _FROZEN_CLASSES[cls] = frozen
+    return frozen
+
+
+def is_frozen(obj) -> bool:
+    """True if ``obj`` is a frozen (shared, immutable) watch-event object."""
+    return isinstance(obj, (FrozenDict, FrozenList)) or (
+        getattr(type(obj), "_frozen_base_", None) is not None
+    )
+
+
+def freeze(obj, _memo=None):
+    """Recursively freeze an object graph IN PLACE and return it.
+
+    Dataclass instances keep their identity (their ``__class__`` is swapped
+    to a mutation-raising subclass); plain dict/list containers are replaced
+    with Frozen variants.  Idempotent, cycle-safe, and cheap relative to a
+    deepcopy: no object payloads are copied.
+    """
+    if _memo is None:
+        _memo = {}
+    oid = id(obj)
+    if oid in _memo:
+        return _memo[oid]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        if getattr(type(obj), "_frozen_base_", None) is not None:
+            return obj
+        _memo[oid] = obj
+        for name, value in list(vars(obj).items()):
+            fv = freeze(value, _memo)
+            if fv is not value:
+                object.__setattr__(obj, name, fv)
+        obj.__class__ = _frozen_class_for(type(obj))
+        return obj
+    if isinstance(obj, (FrozenDict, FrozenList)):
+        return obj
+    if type(obj) is dict:
+        fd = FrozenDict()
+        _memo[oid] = fd
+        for k, v in obj.items():
+            dict.__setitem__(fd, k, freeze(v, _memo))
+        return fd
+    if type(obj) is list:
+        fl = FrozenList()
+        _memo[oid] = fl
+        for v in obj:
+            list.append(fl, freeze(v, _memo))
+        return fl
+    return obj

@@ -390,6 +390,45 @@ class UpgradeMetrics:
             "budget_parallel_used",
             "Groups currently holding an in-progress budget claim",
         )
+        # Materialized-view surface (upgrade/matview.py): the O(delta)
+        # incremental read path.  The view is an optimization, never an
+        # authority — hits vs fallbacks show how often ticks avoided a
+        # full scoped build, diff mismatches count every disagreement
+        # the resync audit found (each one also triggered a fail-open
+        # reseed).
+        r.describe(
+            "matview_hits_total",
+            "Pool reconciles served from the materialized view "
+            "(O(changed-objects) build, no informer re-scan)",
+        )
+        r.describe(
+            "matview_fallback_rebuilds_total",
+            "Pool reconciles that fell back to a full scoped "
+            "build_state (view unseeded / stale / invalidated)",
+        )
+        r.describe(
+            "matview_diff_mismatches_total",
+            "View-vs-build_state disagreements found by the full-resync "
+            "audit (each batch triggers a fail-open reseed)",
+        )
+        r.describe(
+            "matview_pools",
+            "Pools currently materialized in the view",
+        )
+        r.describe(
+            "matview_rows",
+            "Node rows currently materialized in the view",
+        )
+        r.describe(
+            "matview_interned_strings",
+            "Distinct strings in the view's intern pool (state labels, "
+            "pool keys)",
+        )
+        r.describe(
+            "matview_apply_latency_us",
+            "Mean per-delta view apply latency in microseconds "
+            "(runs under the informer lock; must stay O(1))",
+        )
         # Fused probe-battery surface (health.fused; absent when the
         # controller never probed in-process, e.g. NodeReportProber-only
         # deployments where the agents run the battery instead).
@@ -1157,6 +1196,27 @@ class UpgradeMetrics:
         r.set("budget_unavailable_used", ledger.unavailable_used())
         r.set("budget_unavailable_cap", ledger.max_unavailable)
         r.set("budget_parallel_used", ledger.parallel_used())
+        view = getattr(sharded, "matview", None)
+        if view is not None:
+            r.set("matview_hits_total", sstats.get("matview_hits", 0))
+            r.set(
+                "matview_fallback_rebuilds_total",
+                sstats.get("matview_fallbacks", 0),
+            )
+            r.set(
+                "matview_diff_mismatches_total",
+                view.stats.get("diff_mismatches", 0),
+            )
+            vstats = view.snapshot_stats()
+            r.set("matview_pools", vstats["pools"])
+            r.set("matview_rows", vstats["rows"])
+            r.set(
+                "matview_interned_strings", vstats["interned_strings"]
+            )
+            r.set(
+                "matview_apply_latency_us",
+                round(vstats["apply_avg_us"], 3),
+            )
         if report is not None:
             r.set("reconcile_dirty_pools", report.pools_walked)
             r.set("dirty_tick_duration_seconds", report.duration_s)

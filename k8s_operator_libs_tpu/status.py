@@ -47,6 +47,13 @@ SHARDED_METRIC_KEYS = {
     "budget_unavailable_used": "budgetUsed",
     "budget_unavailable_cap": "budgetCap",
     "budget_parallel_used": "budgetParallel",
+    "matview_hits_total": "viewHits",
+    "matview_fallback_rebuilds_total": "viewFallbacks",
+    "matview_diff_mismatches_total": "viewDiffMismatches",
+    "matview_pools": "viewPools",
+    "matview_rows": "viewRows",
+    "matview_interned_strings": "viewInternedStrings",
+    "matview_apply_latency_us": "viewApplyLatencyUs",
 }
 
 
@@ -922,6 +929,19 @@ def render(status: dict) -> str:
                 f"errors {int(sharded.get('shardErrors', 0))}, "
                 f"fenced {int(sharded.get('shardFenced', 0))}"
             )
+            if "viewPools" in sharded:
+                lines.append(
+                    f"  materialized view: "
+                    f"{int(sharded.get('viewPools', 0))} pools "
+                    f"{int(sharded.get('viewRows', 0))} rows | "
+                    f"hits {int(sharded.get('viewHits', 0))} "
+                    f"fallbacks {int(sharded.get('viewFallbacks', 0))} | "
+                    f"diff mismatches "
+                    f"{int(sharded.get('viewDiffMismatches', 0))} | "
+                    f"interned {int(sharded.get('viewInternedStrings', 0))}"
+                    f", apply "
+                    f"{sharded.get('viewApplyLatencyUs', 0.0):.1f}us"
+                )
     battery = status.get("probeBattery")
     if battery is not None:
         lines.append("")

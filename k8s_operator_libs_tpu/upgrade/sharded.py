@@ -53,6 +53,7 @@ from k8s_operator_libs_tpu.upgrade.consts import (
     IN_PROGRESS_STATES,
     UpgradeState,
 )
+from k8s_operator_libs_tpu.upgrade.matview import MaterializedFleetView
 from k8s_operator_libs_tpu.upgrade.util import UpgradeKeys
 
 logger = get_logger(__name__)
@@ -811,6 +812,25 @@ class ShardedReconciler:
         # freed budget goes to the group the plan says is next instead
         # of whichever denied pool's shard wins the race.
         self.plan_provider: Optional[Callable[[], Optional[object]]] = None
+        # Materialized fleet view (matview.py): available only when the
+        # manager reads through a CachedKubeClient whose informer can
+        # feed store deltas.  Strictly a read-path optimization — every
+        # miss falls back to the scoped build_state below, and every
+        # full resync audits + reseeds it (view-is-not-authority).
+        self.matview: Optional[MaterializedFleetView] = None
+        informer = getattr(
+            getattr(manager, "client", None), "informer", None
+        )
+        if informer is not None and hasattr(
+            informer, "add_change_listener"
+        ):
+            self.matview = MaterializedFleetView(
+                manager.keys,
+                namespace,
+                driver_labels,
+                fresh_fn=informer.fresh,
+            )
+            informer.add_change_listener(self.matview.on_store_change)
 
     # -- feed ----------------------------------------------------------------
 
@@ -928,6 +948,23 @@ class ShardedReconciler:
         )
         self.ledger.sync_from_state(self.manager, state, policy)
         self._seeded = True
+        # Materialized-view anchor: audit the incremental rows against
+        # the ground-truth state this resync just built (mismatch =
+        # counter + log, never a crash), then reseed from a fresh
+        # copy-on-write snapshot so the next delta window starts from
+        # a provably current baseline.
+        if self.matview is not None:
+            mismatches = self.matview.diff_against(state)
+            if mismatches:
+                self.stats["matview_diff_mismatches"] += mismatches
+            snapshot_fn = getattr(
+                self.manager.client, "coherent_snapshot", None
+            )
+            snap = snapshot_fn() if callable(snapshot_fn) else None
+            if snap is not None:
+                self.matview.reseed(snap)
+            else:
+                self.matview.mark_stale()
         return started
 
     def complete_full_resync(self, started: float) -> None:
@@ -1031,12 +1068,27 @@ class ShardedReconciler:
                 self.queue.done(key)
                 self.stats["empty_pools"] += 1
                 return "empty"
-            state = self.manager.build_state(
-                self.namespace,
-                self.driver_labels,
-                policy,
-                scope_nodes=scope,
-            )
+            # O(delta) fast path: materialize this pool's state from
+            # the incrementally-maintained view (deep-copying only the
+            # pool's own rows).  Any reason the view cannot serve —
+            # unseeded, reset, stale feed, invalidated pool — returns
+            # None and the classic scoped build_state runs instead.
+            state = None
+            if self.matview is not None:
+                state = self.matview.build_pool_state(
+                    key, policy, self.manager
+                )
+            if state is not None:
+                self.stats["matview_hits"] += 1
+            else:
+                if self.matview is not None:
+                    self.stats["matview_fallbacks"] += 1
+                state = self.manager.build_state(
+                    self.namespace,
+                    self.driver_labels,
+                    policy,
+                    scope_nodes=scope,
+                )
             self.manager.apply_state(state, policy, scoped=True)
             self.queue.done(key)
             self.stats["pools_reconciled"] += 1
@@ -1047,6 +1099,10 @@ class ShardedReconciler:
             # crash landed between a claim and its label write.
             logger.warning("shard reconcile of pool %s failed: %s", key, e)
             self.queue.done(key, requeue=True)
+            if self.matview is not None:
+                # Distrust the pool's rows after a mid-pass crash: its
+                # next attempt rebuilds from ground truth.
+                self.matview.invalidate_pool(key)
             self.stats["shard_errors"] += 1
             return "error"
         finally:

@@ -44,7 +44,7 @@ from k8s_operator_libs_tpu.k8s.drain import (
     escalation_from_spec,
 )
 from k8s_operator_libs_tpu.k8s.interface import KubeClient
-from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod
+from k8s_operator_libs_tpu.k8s.objects import DaemonSet, Node, Pod, deep_copy
 from k8s_operator_libs_tpu.k8s.writeplan import WritePlan
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
 from k8s_operator_libs_tpu.upgrade.consts import (
@@ -774,9 +774,16 @@ class ClusterUpgradeStateManager:
                 snapshot = snapshot_fn(node_names=scope_nodes)
             except TypeError:  # older/injected snapshot providers
                 snapshot = snapshot_fn()
+        # A shared (copy-on-write) snapshot lends out the informer
+        # store's own objects: the engine mutates node/pod state in
+        # place during a pass (provider read-your-writes), so every
+        # object MATERIALIZED into the returned state must be privately
+        # copied here.  Only driver daemonsets and the pods/nodes that
+        # actually enter the state are copied — never the whole store.
+        shared = bool(snapshot is not None and getattr(snapshot, "shared", False))
         if snapshot is not None:
             daemon_sets = {
-                ds.metadata.uid: ds
+                ds.metadata.uid: deep_copy(ds) if shared else ds
                 for ds in snapshot.list_daemon_sets(
                     namespace, driver_labels
                 )
@@ -822,6 +829,10 @@ class ClusterUpgradeStateManager:
 
         state = ClusterUpgradeState()
         node_states_by_name: dict[str, NodeUpgradeState] = {}
+        # COW materialization cache: a node referenced by two pods must
+        # resolve to the SAME private copy (matching the eager-snapshot
+        # behavior, where both lookups hit one copied object).
+        node_copies: dict[str, Node] = {}
         for pod, ds in filtered:
             if not pod.spec.node_name:
                 logger.info("driver pod %s has no node, skipping", pod.name)
@@ -829,6 +840,13 @@ class ClusterUpgradeStateManager:
             node = None
             if snapshot is not None:
                 node = snapshot.get_node(pod.spec.node_name)
+                if node is not None and shared:
+                    copied = node_copies.get(node.name)
+                    if copied is None:
+                        copied = deep_copy(node)
+                        node_copies[node.name] = copied
+                    node = copied
+                    pod = deep_copy(pod)
             else:
                 try:
                     node = self.provider.get_node(pod.spec.node_name)

@@ -60,6 +60,12 @@ def _bench_shaped_summary() -> dict:
         "sharded_idle_pools_walked": 0,
         "sharded_idle_p99_tick_s": 0.000123,
         "sharded_active_pools_walked": 1,
+        "incremental_idle_pools_walked": 0,
+        "incremental_active_tick_s": 0.123456,
+        "incremental_matview_hits": 1,
+        "incremental_resync_diff_mismatches": 0,
+        "incremental_snapshot_build_s": 0.123456,
+        "incremental_peak_rss_mib": 1234.5,
         "write_hygiene_writes_per_transition": 1.429,
         "write_hygiene_idle_writes": 0,
         "write_hygiene_event_collapse": 25.0,

@@ -1,0 +1,302 @@
+"""Copy-on-write informer snapshots: structural sharing with version-
+stamped identity reuse, point-in-time isolation of held snapshots (the
+parity oracle against the eager deep-copy snapshot this replaced), and
+a seeded fuzz battery that keeps snapshots alive across write storms
+and watch kills."""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster, NotFoundError
+from k8s_operator_libs_tpu.k8s.client import WatchEvent
+from k8s_operator_libs_tpu.k8s.informer import CachedKubeClient, Informer
+from k8s_operator_libs_tpu.k8s.objects import deep_copy
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+def _fleet(n_pools: int = 2, hosts: int = 2):
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    pools = {}
+    for i in range(n_pools):
+        name = f"pool-{chr(ord('a') + i)}"
+        pools[name] = fx.tpu_slice(
+            name, hosts=hosts, state=UpgradeState.DONE,
+            topology={2: "2x2x2"}.get(hosts),
+        )
+        for n in pools[name]:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    return cluster, fx, ds, pools
+
+
+def _oracle(snap):
+    """The old eager snapshot: a full deep copy of every map, taken the
+    instant the COW snapshot is.  Any later divergence between the held
+    COW view and this oracle is a copy-on-write isolation bug."""
+    return {
+        "nodes": {k: deep_copy(v) for k, v in snap.nodes.items()},
+        "pods": {k: deep_copy(v) for k, v in snap.pods.items()},
+        "daemon_sets": {
+            k: deep_copy(v) for k, v in snap.daemon_sets.items()
+        },
+        "revisions": {k: deep_copy(v) for k, v in snap.revisions.items()},
+    }
+
+
+def _assert_matches_oracle(snap, oracle):
+    for attr in ("nodes", "pods", "daemon_sets", "revisions"):
+        held = getattr(snap, attr)
+        want = oracle[attr]
+        assert held.keys() == want.keys(), attr
+        for key, obj in held.items():
+            assert obj == want[key], (attr, key)
+
+
+def _feed_node(cluster, informer, name):
+    node = cluster.get_node(name, cached=False)
+    informer.handle_event(
+        WatchEvent("MODIFIED", "Node", node, node.metadata.resource_version)
+    )
+
+
+class TestIdentityAndSharing:
+    def test_unchanged_store_returns_the_identical_snapshot(self):
+        cluster, _, _, _ = _fleet()
+        informer = Informer(cluster)
+        informer.sync()
+        snap1 = informer.snapshot()
+        assert informer.snapshot() is snap1
+        assert informer.snapshot() is snap1
+        assert informer.stats["snapshot_reuses"] == 2
+        assert informer.stats["snapshot_builds"] == 1
+        assert snap1.shared is True
+
+    def test_delta_invalidates_and_rebuilds_with_shared_kind_maps(self):
+        cluster, _, _, pools = _fleet()
+        informer = Informer(cluster)
+        informer.sync()
+        snap1 = informer.snapshot()
+        _feed_node(cluster, informer, pools["pool-a"][0].name)
+        snap2 = informer.snapshot()
+        assert snap2 is not snap1
+        assert snap2.version > snap1.version
+        # Untouched kinds share the SAME map object across rebuilds;
+        # only the changed kind's map is rebuilt.
+        assert snap2.daemon_sets is snap1.daemon_sets
+        assert snap2.revisions is snap1.revisions
+        assert snap2.nodes is not snap1.nodes
+        assert informer.stats["kind_map_reuses"] >= 2
+
+    def test_scoped_snapshot_shares_store_objects(self):
+        cluster, _, _, pools = _fleet()
+        informer = Informer(
+            cluster,
+            pod_namespace=NAMESPACE,
+            pod_match_labels=DRIVER_LABELS,
+        )
+        informer.sync()
+        full = informer.snapshot()
+        scope = {n.name for n in pools["pool-b"]}
+        scoped = informer.snapshot(node_names=scope)
+        assert set(scoped.nodes) == scope
+        # No copying on the scoped path either: identical objects.
+        for name in scope:
+            assert scoped.nodes[name] is full.nodes[name]
+        for key, pod in scoped.pods.items():
+            assert pod is full.pods[key]
+        assert scoped.shared is True
+
+
+class TestHeldSnapshotIsolation:
+    def test_held_snapshot_survives_a_write_storm(self):
+        cluster, fx, ds, pools = _fleet()
+        informer = Informer(cluster)
+        informer.sync()
+        snap = informer.snapshot()
+        oracle = _oracle(snap)
+
+        # Storm: label churn, pod recreation, template bump — each
+        # fed through the informer so the store really changes.
+        for name, nodes in pools.items():
+            for n in nodes:
+                cluster.patch_node_labels(
+                    n.name, {KEYS.state_label: "upgrade-required"}
+                )
+                _feed_node(cluster, informer, n.name)
+        victim = f"driver-{pools['pool-a'][0].name}"
+        cluster.delete_pod(ds.namespace, victim)
+        fx.bump_daemon_set_template(ds, "v2", revision=2)
+        informer.sync()
+
+        # The store moved on...
+        live = informer.get_node(pools["pool-a"][0].name)
+        assert live.labels[KEYS.state_label] == "upgrade-required"
+        # ...the held view did not.
+        _assert_matches_oracle(snap, oracle)
+
+    def test_post_snapshot_mutation_of_build_state_never_bleeds(self):
+        """build_state on a COW snapshot materializes private copies:
+        mutating engine state must not reach the informer store or any
+        held snapshot."""
+        cluster, _, _, pools = _fleet()
+        informer = Informer(
+            cluster,
+            pod_namespace=NAMESPACE,
+            pod_match_labels=DRIVER_LABELS,
+        )
+        cached = CachedKubeClient(cluster, informer=informer)
+        informer.sync()
+        mgr = ClusterUpgradeStateManager(
+            cached, keys=KEYS, poll_interval_s=0.01, poll_timeout_s=2.0
+        )
+        policy = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            drain_spec=DrainSpec(enable=False),
+        )
+        snap = informer.snapshot()
+        oracle = _oracle(snap)
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        for nus_list in state.node_states.values():
+            for nus in nus_list:
+                nus.node.labels["mutated"] = "yes"
+                if nus.driver_pod is not None:
+                    nus.driver_pod.metadata.labels["mutated"] = "yes"
+                if nus.driver_daemon_set is not None:
+                    nus.driver_daemon_set.metadata.labels["mutated"] = "y"
+        _assert_matches_oracle(snap, oracle)
+        for name in snap.nodes:
+            assert "mutated" not in informer.get_node(name).labels
+
+    def test_two_pods_on_one_node_share_one_private_node_copy(self):
+        """The eager snapshot deep-copied the node map once, so two pods
+        on the same node resolved to the SAME node copy; the COW path
+        must preserve that via its per-build node-copy cache."""
+        cluster, fx, ds, pools = _fleet()
+        node = pools["pool-a"][0]
+        fx.driver_pod(node, ds, hash_suffix="v1", name="driver-twin")
+        informer = Informer(
+            cluster,
+            pod_namespace=NAMESPACE,
+            pod_match_labels=DRIVER_LABELS,
+        )
+        cached = CachedKubeClient(cluster, informer=informer)
+        informer.sync()
+        mgr = ClusterUpgradeStateManager(
+            cached, keys=KEYS, poll_interval_s=0.01, poll_timeout_s=2.0
+        )
+        policy = TPUUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+            drain_spec=DrainSpec(enable=False),
+        )
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        holders = [
+            nus
+            for nus_list in state.node_states.values()
+            for nus in nus_list
+            if nus.node.metadata.name == node.name
+        ]
+        assert len(holders) == 2
+        assert holders[0].node is holders[1].node
+        # ...and that shared copy is private, not the store object.
+        assert holders[0].node is not informer.snapshot().nodes[node.name]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzzed_write_storms_never_bleed_into_held_snapshots(seed):
+    """Property fuzz: across a random schedule of label churn, pod
+    delete/recreate, template bumps, re-lists, and watch kills, every
+    snapshot ever taken must still equal its capture-time deep-copy
+    oracle at the end — and the final snapshot must agree with the
+    ground-truth store."""
+    rng = random.Random(1000 + seed)
+    cluster, fx, ds, pools = _fleet(
+        n_pools=rng.randint(2, 4), hosts=rng.choice([2, 4])
+    )
+    all_nodes = [n for nodes in pools.values() for n in nodes]
+    informer = Informer(
+        cluster,
+        pod_namespace=NAMESPACE,
+        pod_match_labels=DRIVER_LABELS,
+        max_staleness_s=30.0,
+    ).start()
+    assert informer.wait_synced(10.0)
+    held: list = []  # (snapshot, oracle) pairs, kept alive all run
+    revision = 1
+    try:
+        for step in range(rng.randint(30, 60)):
+            op = rng.random()
+            if op < 0.35:
+                node = rng.choice(all_nodes)
+                cluster.patch_node_labels(
+                    node.name,
+                    {
+                        KEYS.state_label: rng.choice(
+                            ["upgrade-required", "upgrade-done", None]
+                        ),
+                        f"fuzz-{rng.randint(0, 3)}": str(step),
+                    },
+                )
+            elif op < 0.55:
+                node = rng.choice(all_nodes)
+                name = f"driver-{node.name}"
+                try:
+                    cluster.delete_pod(ds.namespace, name)
+                except NotFoundError:
+                    fx.driver_pod(node, ds, hash_suffix="v1")
+            elif op < 0.65:
+                revision += 1
+                fx.bump_daemon_set_template(
+                    ds, f"v{revision}", revision=revision
+                )
+            elif op < 0.75:
+                # Kill the feed dead, then restart (full re-list).
+                informer.stop()
+                informer.start()
+                assert informer.wait_synced(10.0)
+            elif op < 0.85:
+                informer.sync()
+            else:
+                snap = informer.snapshot()
+                held.append((snap, _oracle(snap)))
+            if rng.random() < 0.3:
+                time.sleep(0)  # let the feed thread interleave
+        # One last snapshot so every seed holds at least one.
+        snap = informer.snapshot()
+        held.append((snap, _oracle(snap)))
+        informer.sync()
+    finally:
+        informer.stop()
+
+    assert held
+    for snap, oracle in held:
+        _assert_matches_oracle(snap, oracle)
+    # The final post-sync view agrees with ground truth node-for-node.
+    final = informer.snapshot()
+    for node in all_nodes:
+        live = cluster.get_node(node.name, cached=False)
+        assert final.nodes[node.name].labels == live.labels
+        assert (
+            final.nodes[node.name].metadata.resource_version
+            == live.metadata.resource_version
+        )

@@ -468,6 +468,10 @@ class TestScopedPasses:
             shards=1
         )
         try:
+            # Exercise the build_state fallback path: with the
+            # materialized view serving, the injected build crash would
+            # never run (test_matview covers the view's error path).
+            sharded.matview = None
             _full_resync(mgr, sharded, policy)
             real_build = mgr.build_state
             boom = {"armed": True}

@@ -1,0 +1,370 @@
+"""Materialized fleet view (matview.py): parity with build_state,
+view-served ticks, fail-open fallbacks (stale feed, shard error,
+injected corruption), the resync audit, and row maintenance under
+informer deltas (pool moves, node recreate limbo, interning)."""
+
+from __future__ import annotations
+
+import time
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    IntOrString,
+    SliceHealthGateSpec,
+    TPUUpgradePolicySpec,
+)
+from k8s_operator_libs_tpu.k8s import FakeCluster
+from k8s_operator_libs_tpu.k8s.client import WatchEvent
+from k8s_operator_libs_tpu.k8s.informer import CachedKubeClient, Informer
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.sharded import ShardedReconciler
+from tests.fixtures import DRIVER_LABELS, NAMESPACE, ClusterFixture
+
+KEYS = UpgradeKeys()
+
+
+def _policy(max_unavailable: int = 1, parallel: int = 1):
+    return TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=parallel,
+        max_unavailable=IntOrString(max_unavailable),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+        health_gate=SliceHealthGateSpec(enable=False),
+    )
+
+
+def _env(n_pools: int = 3, hosts: int = 2, state=UpgradeState.DONE):
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, KEYS)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    pools: dict[str, list] = {}
+    for i in range(n_pools):
+        name = f"pool-{chr(ord('a') + i)}"
+        pools[name] = fx.tpu_slice(
+            name, hosts=hosts, state=state,
+            topology={2: "2x2x2"}.get(hosts),
+        )
+        for n in pools[name]:
+            fx.driver_pod(n, ds, hash_suffix="v1")
+    informer = Informer(
+        cluster, pod_namespace=NAMESPACE, pod_match_labels=DRIVER_LABELS
+    )
+    cached = CachedKubeClient(cluster, informer=informer)
+    informer.sync()
+    mgr = ClusterUpgradeStateManager(
+        cached, keys=KEYS, poll_interval_s=0.01, poll_timeout_s=2.0
+    )
+    policy = _policy()
+    sharded = ShardedReconciler(mgr, NAMESPACE, DRIVER_LABELS, shards=2)
+    return cluster, fx, ds, pools, informer, mgr, policy, sharded
+
+
+def _full_resync(mgr, sharded, policy):
+    t0 = time.monotonic()
+    state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+    started = sharded.observe_full_state(state, policy, started=t0)
+    mgr.apply_state(state, policy)
+    sharded.complete_full_resync(started)
+
+
+def _feed(cluster, informer, sharded, kind, name, namespace=None):
+    """Deliver one MODIFIED delta for a live object to BOTH consumers,
+    the way the controller's watch pump does."""
+    if kind == "Node":
+        obj = cluster.get_node(name, cached=False)
+    else:
+        obj = cluster.get_pod(name, namespace, cached=False)
+    ev = WatchEvent("MODIFIED", kind, obj, obj.metadata.resource_version)
+    informer.handle_event(ev)
+    sharded.handle_event(ev)
+    return obj
+
+
+def _state_shape(state):
+    """Comparable digest of a ClusterUpgradeState: state-label ->
+    sorted (node, pod, ds-uid) triples."""
+    return {
+        label: sorted(
+            (
+                nus.node.metadata.name,
+                nus.driver_pod.metadata.name if nus.driver_pod else None,
+                nus.driver_daemon_set.metadata.uid
+                if nus.driver_daemon_set
+                else None,
+            )
+            for nus in nus_list
+        )
+        for label, nus_list in state.node_states.items()
+        if nus_list
+    }
+
+
+class TestViewParity:
+    def test_view_build_matches_scoped_build_state(self):
+        _, _, _, pools, _, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            for key, nodes in pools.items():
+                via_view = sharded.matview.build_pool_state(
+                    key, policy, mgr
+                )
+                assert via_view is not None
+                classic = mgr.build_state(
+                    NAMESPACE,
+                    DRIVER_LABELS,
+                    policy,
+                    scope_nodes={n.name for n in nodes},
+                )
+                assert _state_shape(via_view) == _state_shape(classic)
+                # Same grouping: one slice group per pool, same members.
+                assert {
+                    g.id for g in via_view.all_groups()
+                } == {g.id for g in classic.all_groups()}
+        finally:
+            sharded.shutdown()
+
+    def test_view_copies_are_private(self):
+        """Objects the view hands out are deep copies: mutating them
+        must not bleed into the rows (which hold store references)."""
+        _, _, _, _, _, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            one = sharded.matview.build_pool_state("pool-a", policy, mgr)
+            nus = next(iter(one.node_states.values()))[0]
+            nus.node.labels["mutated"] = "yes"
+            nus.driver_pod.metadata.labels["mutated"] = "yes"
+            two = sharded.matview.build_pool_state("pool-a", policy, mgr)
+            for lst in two.node_states.values():
+                for fresh in lst:
+                    assert "mutated" not in fresh.node.labels
+                    assert "mutated" not in fresh.driver_pod.metadata.labels
+        finally:
+            sharded.shutdown()
+
+    def test_interned_state_strings_are_shared(self):
+        _, _, _, _, _, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            view = sharded.matview
+            states = [
+                row.state
+                for pv in view._pools.values()
+                for row in pv.rows.values()
+            ]
+            assert len(states) == 6
+            # All six rows carry the SAME string object, not six copies.
+            assert all(s is states[0] for s in states)
+        finally:
+            sharded.shutdown()
+
+
+class TestViewServesTicks:
+    def test_dirty_tick_is_served_from_the_view(self):
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            gen_before = sharded.matview.generation_of("pool-b")
+            _feed(
+                cluster, informer, sharded, "Node", pools["pool-b"][0].name
+            )
+            assert sharded.matview.generation_of("pool-b") > gen_before
+            report = sharded.tick(policy)
+            assert sharded.wait_idle(5.0)
+            assert report.pools_walked == 1
+            assert report.pool_keys == ["pool-b"]
+            assert sharded.stats["matview_hits"] == 1
+            assert sharded.stats.get("matview_fallbacks", 0) == 0
+        finally:
+            sharded.shutdown()
+
+    def test_stale_feed_falls_back_to_build_state(self):
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            sharded.matview.fresh_fn = lambda: False
+            _feed(
+                cluster, informer, sharded, "Node", pools["pool-a"][0].name
+            )
+            report = sharded.tick(policy)
+            assert sharded.wait_idle(5.0)
+            assert report.pools_walked == 1
+            assert sharded.stats.get("matview_hits", 0) == 0
+            assert sharded.stats["matview_fallbacks"] == 1
+            assert sharded.matview.stats["misses_stale"] == 1
+        finally:
+            sharded.shutdown()
+
+    def test_shard_error_invalidates_the_pool(self):
+        """An exception mid-pool distrusts the view for that pool: the
+        retry falls back to build_state until the next reseed."""
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            real = mgr._build_groups
+            boom = {"armed": True}
+
+            def exploding(*a, **kw):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected mid-view build")
+                return real(*a, **kw)
+
+            mgr._build_groups = exploding
+            _feed(
+                cluster, informer, sharded, "Node", pools["pool-a"][0].name
+            )
+            report = sharded.tick(policy)
+            assert sharded.wait_idle(5.0)
+            assert report.errors == 1
+            assert sharded.matview.stats["pool_invalidations"] == 1
+            # The crashed pool was requeued; the retry must not trust
+            # the invalidated rows.
+            report = sharded.tick(policy)
+            assert sharded.wait_idle(5.0)
+            assert report.pools_walked == 1 and report.errors == 0
+            assert sharded.stats["matview_fallbacks"] >= 1
+            assert sharded.matview.stats["misses_invalid"] >= 1
+            # A full resync re-arms the view for that pool.
+            _full_resync(mgr, sharded, policy)
+            assert sharded.matview.build_pool_state(
+                "pool-a", policy, mgr
+            ) is not None
+        finally:
+            sharded.shutdown()
+
+
+class TestResyncAudit:
+    def test_clean_fleet_audits_to_zero_mismatches(self):
+        _, _, _, _, _, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            _full_resync(mgr, sharded, policy)
+            assert sharded.stats.get("matview_diff_mismatches", 0) == 0
+            assert sharded.matview.stats.get("diff_mismatches", 0) == 0
+            assert sharded.matview.stats["reseeds"] >= 2
+        finally:
+            sharded.shutdown()
+
+    def test_injected_corruption_is_caught_and_healed(self):
+        """Tamper a row behind the view's back: the next full resync's
+        audit MUST count the mismatch, and the fail-open reseed must
+        leave the view clean again."""
+        _, _, _, _, _, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            view = sharded.matview
+            row = next(iter(view._pools["pool-b"].rows.values()))
+            row.state = view.interner.intern("upgrade-corrupted")
+            _full_resync(mgr, sharded, policy)
+            assert sharded.stats["matview_diff_mismatches"] >= 1
+            assert view.stats["diff_mismatches"] >= 1
+            # The reseed healed it: a third resync audits clean and the
+            # view serves again.
+            before = sharded.stats["matview_diff_mismatches"]
+            _full_resync(mgr, sharded, policy)
+            assert sharded.stats["matview_diff_mismatches"] == before
+            assert view.build_pool_state("pool-b", policy, mgr) is not None
+        finally:
+            sharded.shutdown()
+
+    def test_missed_delta_is_caught_by_the_audit(self):
+        """A delta the informer (and so the view) never saw: the store
+        is behind ground truth, but the view still matches the SNAPSHOT
+        the resync built — so the audit stays clean only because the
+        resync build reads through the same informer.  Force the skew
+        by writing around the informer and re-listing: the reset path
+        must unseed the view, not serve garbage."""
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            cluster.patch_node_labels(
+                pools["pool-a"][0].name,
+                {KEYS.state_label: UpgradeState.UPGRADE_REQUIRED.value},
+            )
+            informer.sync()  # re-list fires the reset listener
+            assert sharded.matview.seeded is False
+            assert sharded.matview.build_pool_state(
+                "pool-a", policy, mgr
+            ) is None
+            assert sharded.matview.stats["misses_unseeded"] >= 1
+            _full_resync(mgr, sharded, policy)  # reseeds
+            assert sharded.matview.seeded is True
+        finally:
+            sharded.shutdown()
+
+
+class TestRowMaintenance:
+    def test_node_relabel_moves_the_row_between_pools(self):
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            view = sharded.matview
+            from k8s_operator_libs_tpu.upgrade import consts as C
+
+            node = cluster.patch_node_labels(
+                pools["pool-a"][0].name, {C.GKE_NODEPOOL_LABEL: "pool-z"}
+            )
+            _feed(cluster, informer, sharded, "Node", node.name)
+            assert view._node_pool[node.name] == "pool-z"
+            assert node.name not in view._pools["pool-a"].rows
+            assert node.name in view._pools["pool-z"].rows
+            # Its driver pod followed the move (via limbo re-adoption).
+            moved = view._pools["pool-z"].rows[node.name]
+            assert len(moved.pods) == 1
+        finally:
+            sharded.shutdown()
+
+    def test_node_recreate_readopts_limbo_pods(self):
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            view = sharded.matview
+            name = pools["pool-c"][0].name
+            node = cluster.get_node(name, cached=False)
+            ev = WatchEvent(
+                "DELETED", "Node", node, node.metadata.resource_version
+            )
+            informer.handle_event(ev)
+            sharded.handle_event(ev)
+            assert name not in view._pools["pool-c"].rows
+            assert len(view._limbo_pods) == 1  # pod waits for its node
+            # The repaired node returns: the pod re-attaches.
+            _feed(cluster, informer, sharded, "Node", name)
+            row = view._pools["pool-c"].rows[name]
+            assert len(row.pods) == 1 and not view._limbo_pods
+        finally:
+            sharded.shutdown()
+
+    def test_out_of_scope_pod_never_enters_rows(self):
+        cluster, fx, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            view = sharded.matview
+            wl = fx.workload_pod(pools["pool-a"][0], namespace="default")
+            ev = WatchEvent(
+                "ADDED", "Pod", wl, wl.metadata.resource_version
+            )
+            informer.handle_event(ev)
+            sharded.handle_event(ev)
+            row = view._pools["pool-a"].rows[pools["pool-a"][0].name]
+            assert len(row.pods) == 1  # still only the driver pod
+            assert not view._limbo_pods
+        finally:
+            sharded.shutdown()
+
+    def test_apply_cost_is_tracked(self):
+        cluster, _, _, pools, informer, mgr, policy, sharded = _env()
+        try:
+            _full_resync(mgr, sharded, policy)
+            for n in pools["pool-a"]:
+                _feed(cluster, informer, sharded, "Node", n.name)
+            stats = sharded.matview.snapshot_stats()
+            assert stats["seeded"] is True
+            assert stats["pools"] == 3 and stats["rows"] == 6
+            assert stats["apply_avg_us"] > 0.0
+        finally:
+            sharded.shutdown()

@@ -34,6 +34,11 @@ from k8s_operator_libs_tpu.k8s import (
     Node,
     RestClient,
 )
+from k8s_operator_libs_tpu.k8s.objects import (
+    FrozenObjectError,
+    deep_copy,
+    is_frozen,
+)
 from k8s_operator_libs_tpu.upgrade import UpgradeKeys
 from tests.fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE, make_node
 
@@ -159,29 +164,45 @@ def test_watch_unregistered_cr_surfaces_error():
 
 
 def test_watch_event_snapshots_are_isolated():
-    """Mutating a received event object must not corrupt the store's
-    cache history or other subscribers' views.
+    """A consumer must not be able to corrupt the store's cache history
+    or other subscribers' views through its event object.
 
     Publishing enqueues ONE shared event object (no per-watcher
-    deepcopy under the cluster lock); the isolating copy happens in
-    WatchSubscription.get on the consumer's thread — so this pins that
-    the isolation really happens for live delivery, replay-from-rv
-    (which shares the event-log entries), and the cache-lag history."""
+    deepcopy under the cluster lock); isolation is by IMMUTABILITY, not
+    copying — the first get() freezes the shared snapshot in place, so
+    every subscriber (live, replay-from-rv, and the cache-lag history
+    behind them) reads the same frozen object, any mutation attempt
+    raises, and deep_copy() hands out a private thawed copy."""
     cluster = FakeCluster(cache_lag_s=0.0)
     with cluster.watch(["Node"]) as a, cluster.watch(["Node"]) as b:
         cluster.create_node(make_node("n0"))
         ev_a = a.get(timeout_s=2.0)
-        ev_a.object.labels["corrupted"] = "yes"
-        assert "corrupted" not in b.get(timeout_s=2.0).object.labels
+        ev_b = b.get(timeout_s=2.0)
+        # One shared copy per event: both subscribers see the SAME
+        # frozen object, not two deepcopies.
+        assert ev_a.object is ev_b.object
+        assert is_frozen(ev_a.object)
+        with pytest.raises(FrozenObjectError):
+            ev_a.object.labels["corrupted"] = "yes"
+        with pytest.raises(FrozenObjectError):
+            ev_a.object.spec.unschedulable = True
+        assert "corrupted" not in ev_b.object.labels
+        assert "corrupted" not in cluster.get_node("n0").labels
+        # The sanctioned escape hatch: deep_copy thaws to a private
+        # mutable object without touching the shared view.
+        mine = deep_copy(ev_a.object)
+        assert not is_frozen(mine)
+        mine.labels["corrupted"] = "yes"
+        assert "corrupted" not in ev_b.object.labels
         assert "corrupted" not in cluster.get_node("n0").labels
     # Replay path: a reconnecting subscriber replays retained log
-    # events — which are the SAME objects the live path delivered, so a
-    # missing get()-side copy would leak one consumer's mutation into
-    # every future replay.
+    # events — the SAME (now frozen) objects the live path delivered.
     with cluster.watch(["Node"], since_rv=0) as c:
         ev_c = c.get(timeout_s=2.0)
+        assert is_frozen(ev_c.object)
         assert "corrupted" not in ev_c.object.labels
-        ev_c.object.labels["corrupted-too"] = "yes"
+        with pytest.raises(FrozenObjectError):
+            ev_c.object.labels["corrupted-too"] = "yes"
     with cluster.watch(["Node"], since_rv=0) as d:
         labels = d.get(timeout_s=2.0).object.labels
         assert "corrupted" not in labels

@@ -2,7 +2,7 @@
 the sharded dirty-set reconcile, and the fused probe battery.
 
 ``make bench-guard`` runs this standalone (no accelerator needed — the
-probe stage runs on jax's virtual CPU mesh), in three stages:
+probe stage runs on jax's virtual CPU mesh).  The core stages:
 
 1. **Cached reconcile** (256 nodes): builds the steady-state pool from
    the scale pin (tests/test_scale.py), syncs an Informer, drives full
@@ -19,15 +19,26 @@ probe stage runs on jax's virtual CPU mesh), in three stages:
    ceiling, and a single watch delta must make the next tick walk
    exactly 1 pool (never the fleet).
 
-3. **Fused probe battery** (8-device CPU mesh): runs the single-dispatch
+3. **Incremental O(delta) reconcile** (100,000 nodes): seeds the
+   materialized pool view and the copy-on-write snapshot path at fleet
+   scale, then pins the whole read path: the full-resync
+   view-vs-build_state audit reports 0 mismatches, idle ticks walk 0
+   pools at 0 API requests, one watch delta reconciles exactly 1 pool
+   *from the view* under a fixed ceiling, ``snapshot()`` reuses
+   identity (zero full-map deep copies) under its build ceiling, and
+   peak RSS stays inside a budget sized so one retained eager copy of
+   the 200k-object fleet would blow through it.
+
+4. **Fused probe battery** (8-device CPU mesh): runs the single-dispatch
    battery cold then warm and pins the compile-cache contract — the
    second run of the same topology MUST be a cache hit, the warm battery
    must finish under its per-node ceiling, and the full async validation
    gate (stamp -> healthy verdict through ValidationManager +
    LocalDeviceProber) must clear one slice under its wall-time ceiling.
 
-bench.py imports ``measure()`` / ``measure_sharded()`` for its
-``cached_reconcile`` / ``sharded_reconcile`` stages so the nightly
+bench.py imports ``measure()`` / ``measure_sharded()`` /
+``measure_incremental()`` for its ``cached_reconcile`` /
+``sharded_reconcile`` / ``incremental_100k`` stages so the nightly
 artifact records the same numbers this gate enforces; its
 ``fused_battery`` artifact records the same cache-hit/warm-time
 contract from the production-size battery on the real backend
@@ -66,6 +77,41 @@ SHARDED_IDLE_P99_CEILING_S = 0.05
 # One dirty pool = one scoped build (16 nodes) + one scoped apply; a
 # second of wall-clock means the scoped path regressed to O(fleet).
 SHARDED_ACTIVE_TICK_CEILING_S = 1.0
+
+# Incremental-view stage: the 100k-node O(delta) pin — 24x the sharded
+# fleet, seeded through ONE full resync with the materialized view
+# attached.  Pins: idle ticks walk exactly 0 pools and issue exactly 0
+# API requests; one watch delta walks exactly 1 pool and the view (not
+# a scoped rebuild) serves it (matview_hits >= 1) under the active
+# ceiling; rebuilding the cluster-wide snapshot after a store write is
+# SHALLOW (structure-shared COW — the eager per-object deepcopy this
+# replaced costs seconds at 200k objects, the ceiling admits only the
+# two dict copies); an unchanged store returns the IDENTICAL cached
+# snapshot object; the resync view-vs-build_state audit reports 0
+# mismatches; and process peak RSS stays inside its budget.
+INC_N_SLICES = 6250
+INC_HOSTS_PER_SLICE = 16  # 6250 x 16 = 100,000 nodes
+INC_IDLE_TICKS = 50
+# Same idle discipline as the sharded stage: an empty dirty queue is
+# O(µs) regardless of fleet size — the ceiling only trips on an
+# O(fleet) walk returning to the idle path.
+INC_IDLE_P99_CEILING_S = 0.05
+# One dirty pool = one 16-row view materialization + one scoped apply.
+# Fleet size must NOT appear in this number: that is the whole pin.
+INC_ACTIVE_TICK_CEILING_S = 1.0
+# Unscoped snapshot rebuild after a store version bump: two shallow
+# dict copies (100k nodes + 100k pods) plus shared kind maps.  The
+# pre-COW eager snapshot deep-copied every object — seconds, not
+# milliseconds — so the ceiling is the regression tripwire.
+INC_SNAPSHOT_BUILD_CEILING_S = 0.5
+# Peak RSS for the whole stage (fixture fleet + apiserver history +
+# informer store + view rows + one full-resync materialization).
+# Measured ~1.9 GiB standalone, ~2.4 GiB when the stage runs last in
+# the full suite (ru_maxrss inherits the earlier fixtures' high-water
+# mark); the budget leaves headroom for neither an extra retained copy
+# of the 200k-object fleet (the eager-snapshot regression) nor a
+# per-node deep copy creeping back into the view.
+INC_RSS_CEILING_MIB = 4096
 
 # Probe-battery stage: CPU-sized battery (the pins are about CACHING
 # and dispatch-count, which are size-independent — real-hardware sizes
@@ -386,6 +432,214 @@ def measure_sharded(
         "active_tick_s": round(active_tick_s, 4),
         "idle_p99_ceiling_s": SHARDED_IDLE_P99_CEILING_S,
         "active_tick_ceiling_s": SHARDED_ACTIVE_TICK_CEILING_S,
+    }
+
+
+def measure_incremental(
+    slices: int = INC_N_SLICES,
+    hosts: int = INC_HOSTS_PER_SLICE,
+    idle_ticks: int = INC_IDLE_TICKS,
+) -> dict:
+    """O(delta) reconcile at 100,000 nodes through the materialized
+    view + COW snapshots; returns the artifact dict (also embedded in
+    BENCH_DETAILS.json by bench.py)."""
+    import resource
+    import time
+
+    from k8s_operator_libs_tpu.api import (
+        DrainSpec,
+        IntOrString,
+        TPUUpgradePolicySpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.k8s.client import WatchEvent
+    from k8s_operator_libs_tpu.k8s.informer import (
+        CachedKubeClient,
+        Informer,
+    )
+    from k8s_operator_libs_tpu.k8s.objects import (
+        ContainerStatus,
+        ObjectMeta,
+        OwnerReference,
+        Pod,
+        PodPhase,
+        PodSpec,
+        PodStatus,
+    )
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+        UpgradeState,
+    )
+    from k8s_operator_libs_tpu.upgrade.sharded import ShardedReconciler
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    selector = dict(ds.spec.selector.match_labels)
+    t0 = time.monotonic()
+    for i in range(slices):
+        for n in fx.tpu_slice(
+            f"pool-{i:04d}", hosts=hosts, state=UpgradeState.DONE
+        ):
+            # fixtures.driver_pod read-modify-writes the DaemonSet once
+            # per pod — 100k updates of one object just to build the
+            # fixture.  Create the pod directly and settle the DS
+            # status in ONE write below.
+            labels = dict(selector)
+            labels["controller-revision-hash"] = "v1"
+            meta = ObjectMeta(
+                name=f"driver-{n.name}",
+                namespace=ds.namespace,
+                labels=labels,
+            )
+            meta.owner_references = [
+                OwnerReference(
+                    name=ds.name, uid=ds.metadata.uid, kind="DaemonSet"
+                )
+            ]
+            cluster.create_pod(
+                Pod(
+                    metadata=meta,
+                    spec=PodSpec(node_name=n.name),
+                    status=PodStatus(
+                        phase=PodPhase.RUNNING,
+                        container_statuses=[
+                            ContainerStatus(ready=True, restart_count=0)
+                        ],
+                    ),
+                )
+            )
+    ds.status.desired_number_scheduled = slices * hosts
+    cluster.update_daemon_set(ds)
+    fleet_build_s = time.monotonic() - t0
+
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=4,
+        max_unavailable=IntOrString("25%"),
+        drain_spec=DrainSpec(enable=True, timeout_second=5),
+    )
+
+    # The seed resync at this scale takes longer than the default
+    # freshness bound; the stage pins tick cost, not staleness policy.
+    informer = Informer(
+        cluster,
+        pod_namespace=NAMESPACE,
+        pod_match_labels=DRIVER_LABELS,
+        max_staleness_s=600.0,
+    )
+    cached = CachedKubeClient(cluster, informer=informer)
+    mgr = ClusterUpgradeStateManager(cached, keys=keys)
+    t0 = time.monotonic()
+    informer.sync()
+    sync_s = time.monotonic() - t0
+    sharded = ShardedReconciler(mgr, NAMESPACE, DRIVER_LABELS, shards=4)
+    try:
+        # Seed: exactly one full resync.  observe_full_state audits the
+        # materialized view against this build and reseeds it from a
+        # COW snapshot — the audit must be clean on an untouched fleet.
+        t0 = time.monotonic()
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        started = sharded.observe_full_state(state, policy, started=t0)
+        mgr.apply_state(state, policy)
+        sharded.complete_full_resync(started)
+        seed_resync_s = time.monotonic() - t0
+        diff_mismatches = sharded.stats.get("matview_diff_mismatches", 0)
+
+        # COW snapshot pins.  A store version bump invalidates the
+        # cached snapshot; the rebuild must be shallow (two dict copies
+        # + shared kind maps), and an untouched store must return the
+        # IDENTICAL object, not an equal one.
+        node = cluster.get_node("pool-0000-w0", cached=False)
+        informer.handle_event(
+            WatchEvent(
+                "MODIFIED", "Node", node, node.metadata.resource_version
+            )
+        )
+        t0 = time.monotonic()
+        snap1 = informer.snapshot()
+        snapshot_build_s = time.monotonic() - t0
+        snapshot_reused = informer.snapshot() is snap1
+        snapshot_shared = bool(getattr(snap1, "shared", False))
+
+        api_before = sum(cluster.stats.values())
+        idle_walked = 0
+        idle_durations: list[float] = []
+        for _ in range(idle_ticks):
+            report = sharded.tick(policy)
+            idle_walked += report.pools_walked
+            idle_durations.append(report.duration_s)
+        idle_api = sum(cluster.stats.values()) - api_before
+        idle_durations.sort()
+        p50 = idle_durations[len(idle_durations) // 2]
+        p99 = idle_durations[int(len(idle_durations) * 0.99)]
+
+        # One watch delta, fed the way the controller feeds it: informer
+        # ingest (the view applies it in O(1)) + dirty-pool routing.
+        # The next tick must walk exactly that pool, and the view — not
+        # a scoped build_state — must serve it.
+        node = cluster.get_node(
+            f"pool-{slices // 2:04d}-w{hosts // 2}", cached=False
+        )
+        ev = WatchEvent(
+            "MODIFIED", "Node", node, node.metadata.resource_version
+        )
+        t0 = time.monotonic()
+        informer.handle_event(ev)
+        delta_apply_s = time.monotonic() - t0
+        sharded.handle_event(ev)
+        hits_before = sharded.stats.get("matview_hits", 0)
+        t0 = time.monotonic()
+        report = sharded.tick(policy)
+        active_tick_s = time.monotonic() - t0
+        if not sharded.wait_idle(60.0):
+            raise RuntimeError("incremental reconcile did not drain")
+        matview_hits = sharded.stats.get("matview_hits", 0) - hits_before
+        view_stats = (
+            sharded.matview.snapshot_stats()
+            if sharded.matview is not None
+            else {}
+        )
+    finally:
+        sharded.shutdown()
+
+    # ru_maxrss is KiB on Linux, bytes on macOS.
+    maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    peak_rss_mib = (
+        maxrss / 1024 if sys.platform != "darwin" else maxrss / 2**20
+    )
+
+    return {
+        "nodes": slices * hosts,
+        "pools": slices,
+        "fleet_build_s": round(fleet_build_s, 3),
+        "sync_s": round(sync_s, 3),
+        "seed_resync_s": round(seed_resync_s, 3),
+        "resync_diff_mismatches": diff_mismatches,
+        "snapshot_build_s": round(snapshot_build_s, 6),
+        "snapshot_reused": snapshot_reused,
+        "snapshot_shared": snapshot_shared,
+        "idle_ticks": idle_ticks,
+        "idle_pools_walked_total": idle_walked,
+        "idle_api_requests_total": idle_api,
+        "idle_p50_tick_s": round(p50, 6),
+        "idle_p99_tick_s": round(p99, 6),
+        "delta_apply_s": round(delta_apply_s, 6),
+        "active_pools_walked": report.pools_walked,
+        "active_tick_s": round(active_tick_s, 4),
+        "matview_hits": matview_hits,
+        "matview_rows": view_stats.get("rows", 0),
+        "matview_pools": view_stats.get("pools", 0),
+        "matview_interned_strings": view_stats.get("interned_strings", 0),
+        "peak_rss_mib": round(peak_rss_mib, 1),
+        "idle_p99_ceiling_s": INC_IDLE_P99_CEILING_S,
+        "active_tick_ceiling_s": INC_ACTIVE_TICK_CEILING_S,
+        "snapshot_build_ceiling_s": INC_SNAPSHOT_BUILD_CEILING_S,
+        "rss_ceiling_mib": INC_RSS_CEILING_MIB,
     }
 
 
@@ -2420,6 +2674,85 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (federation): {f}", file=sys.stderr)
+        return 1
+
+    # Deliberately LAST: the 100k-node fixture churns ~2 GiB of heap,
+    # and the arena fragmentation it leaves behind adds enough timing
+    # variance to flip the tracing stage's 5% p99-overhead ceiling on a
+    # 1-CPU runner.  Its own pins are counts, identities, and
+    # generous-per-op ceilings, so stage ordering cannot flatter them.
+    incremental = measure_incremental()
+    failures = []
+    if incremental["resync_diff_mismatches"] != 0:
+        failures.append(
+            f"full-resync audit found "
+            f"{incremental['resync_diff_mismatches']} view-vs-build_state "
+            "mismatch(es) (must be exactly 0 — the incremental apply "
+            "path diverged from the authoritative build)"
+        )
+    if incremental["idle_pools_walked_total"] != 0:
+        failures.append(
+            f"idle ticks walked {incremental['idle_pools_walked_total']} "
+            "pools (must be 0 — tick cost is no longer O(changed))"
+        )
+    if incremental["idle_api_requests_total"] != 0:
+        failures.append(
+            f"idle ticks issued {incremental['idle_api_requests_total']} "
+            "API requests (must be 0)"
+        )
+    if incremental["idle_p99_tick_s"] > INC_IDLE_P99_CEILING_S:
+        failures.append(
+            f"idle p99 tick latency {incremental['idle_p99_tick_s']}s > "
+            f"ceiling {INC_IDLE_P99_CEILING_S}s"
+        )
+    if incremental["active_pools_walked"] != 1:
+        failures.append(
+            f"one delta walked {incremental['active_pools_walked']} "
+            "pools (must be exactly 1)"
+        )
+    if incremental["active_tick_s"] > INC_ACTIVE_TICK_CEILING_S:
+        failures.append(
+            f"active tick took {incremental['active_tick_s']}s > ceiling "
+            f"{INC_ACTIVE_TICK_CEILING_S}s at {incremental['nodes']} "
+            "nodes (fleet size leaked into the dirty path)"
+        )
+    if incremental["matview_hits"] < 1:
+        failures.append(
+            "the dirty pool was rebuilt via build_state instead of "
+            "served from the materialized view (matview_hits == 0)"
+        )
+    if not incremental["snapshot_shared"]:
+        failures.append(
+            "informer snapshot is no longer a COW view "
+            "(shared=False — the eager deep-copy snapshot is back)"
+        )
+    if incremental["snapshot_build_s"] > INC_SNAPSHOT_BUILD_CEILING_S:
+        failures.append(
+            f"snapshot rebuild took {incremental['snapshot_build_s']}s "
+            f"> ceiling {INC_SNAPSHOT_BUILD_CEILING_S}s at "
+            f"{incremental['nodes']} nodes (a per-object copy is back "
+            "in snapshot construction)"
+        )
+    if not incremental["snapshot_reused"]:
+        failures.append(
+            "an unchanged store rebuilt its snapshot instead of "
+            "returning the cached object (version clock broken)"
+        )
+    if incremental["peak_rss_mib"] > INC_RSS_CEILING_MIB:
+        failures.append(
+            f"peak RSS {incremental['peak_rss_mib']} MiB > budget "
+            f"{INC_RSS_CEILING_MIB} MiB (the view or snapshot layer "
+            "started copying objects it should only reference)"
+        )
+    incremental["ok"] = not failures
+    print(json.dumps(incremental, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(
+                f"bench-guard FAIL (incremental, "
+                f"{incremental['nodes']} nodes): {f}",
+                file=sys.stderr,
+            )
         return 1
     return 0
 

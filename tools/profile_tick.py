@@ -7,6 +7,12 @@ the top 25 functions by cumulative time.  The first stop when
 bench-guard's tick-cost pins regress: the hot path is the same one the
 controller runs, minus the network.
 
+`--memory` swaps the CPU profile for an allocation profile: tracemalloc
+top-25 call sites by bytes allocated during the tick, plus the process
+peak RSS — the first stop when bench-guard's `incremental_100k` RSS pin
+regresses (e.g. the materialized-view layer starts copying objects it
+should only reference).
+
 Zero external dependencies; everything comes from the repo's own test
 fixtures.
 """
@@ -17,7 +23,9 @@ import argparse
 import cProfile
 import os
 import pstats
+import resource
 import sys
+import tracemalloc
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
@@ -95,12 +103,20 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--top", type=int, default=TOP_N, help="rows to print"
     )
+    parser.add_argument(
+        "--memory",
+        action="store_true",
+        help="profile allocations (tracemalloc) instead of CPU time",
+    )
     args = parser.parse_args(argv)
 
     manager, policy, namespace, labels = build_roll()
     # Warm pass outside the profile: first-touch costs (imports, fixture
     # lazy init) would otherwise drown the steady-state tick.
     tick(manager, policy, namespace, labels)
+
+    if args.memory:
+        return _memory_profile(args, manager, policy, namespace, labels)
 
     prof = cProfile.Profile()
     prof.enable()
@@ -126,6 +142,39 @@ def main(argv=None) -> int:
         )
     stats = pstats.Stats(prof, stream=sys.stdout)
     stats.strip_dirs().sort_stats(args.sort).print_stats(args.top)
+    return 1 if failure is not None else 0
+
+
+def _memory_profile(args, manager, policy, namespace, labels) -> int:
+    """Allocation profile of one tick: top call sites by net bytes
+    allocated (tracemalloc diff around the tick) + peak RSS."""
+    tracemalloc.start(25)
+    before = tracemalloc.take_snapshot()
+    failure: Exception | None = None
+    try:
+        tick(manager, policy, namespace, labels)
+    except Exception as e:  # noqa: BLE001 — report the partial profile
+        failure = e
+    after = tracemalloc.take_snapshot()
+    _, traced_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    print(
+        f"memory profile: one {N_SLICES * HOSTS_PER_SLICE}-node "
+        f"active-roll tick (top {args.top} call sites by net bytes)"
+    )
+    if failure is not None:
+        print(
+            f"tick FAILED mid-profile ({failure!r}); partial profile "
+            "up to the failure point:"
+        )
+    for stat in after.compare_to(before, "lineno")[: args.top]:
+        print(stat)
+    # ru_maxrss is KiB on Linux, bytes on macOS.
+    maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    rss_mib = maxrss / 1024 if sys.platform != "darwin" else maxrss / 2**20
+    print(f"tracemalloc peak during tick: {traced_peak / 2**20:.1f} MiB")
+    print(f"process peak RSS: {rss_mib:.1f} MiB")
     return 1 if failure is not None else 0
 
 
