@@ -59,10 +59,11 @@ lint:
 
 # Static check of the typed client boundary (KubeClient Protocol,
 # k8s/interface.py) plus the fault-tolerance layer.  mypy is not baked
-# into every dev image, so the target degrades to a loud skip when it is
-# absent (the devel image and CI both have it — a real mypy failure
-# still fails the build there); the runtime conformance tests
-# (tests/test_client_interface.py) are the always-on gate.
+# into every dev image, so locally the target degrades to a loud skip
+# when it is absent; in CI (CI env var set) a missing mypy is a broken
+# toolchain and FAILS the build instead of silently passing.  The
+# runtime conformance tests (tests/test_client_interface.py) are the
+# always-on gate either way.
 typecheck:
 	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
 		$(PYTHON) -m mypy --ignore-missing-imports \
@@ -73,6 +74,10 @@ typecheck:
 			k8s_operator_libs_tpu/k8s/retry.py \
 			k8s_operator_libs_tpu/k8s/rest.py \
 			k8s_operator_libs_tpu/upgrade/; \
+	elif [ -n "$$CI" ]; then \
+		echo "typecheck: mypy not installed but CI is set —" \
+			"the CI image must bake in mypy; failing" >&2; \
+		exit 1; \
 	else \
 		echo "typecheck: mypy not installed; skipping" \
 			"(pip install mypy, or run 'make docker-typecheck')"; \

@@ -1,6 +1,9 @@
 """CRD-embeddable policy types (analogue of the reference's ``api/upgrade``)."""
 
 from k8s_operator_libs_tpu.api.v1alpha1 import (  # noqa: F401
+    ArtifactDAGSpec,
+    ArtifactEdgeSpec,
+    ArtifactSpec,
     DrainSpec,
     DriverUpgradePolicySpec,
     ElasticCoordinationSpec,

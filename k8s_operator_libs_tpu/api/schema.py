@@ -39,6 +39,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     _camel,
     _JSON_NAME_OVERRIDES,
 )
+from k8s_operator_libs_tpu.artifacts.dag import GATE_MODES, SKEW_MODES
 
 # ---------------------------------------------------------------------------
 # Validation markers — the kubebuilder-marker analogue, keyed by
@@ -91,6 +92,11 @@ _CONSTRAINTS: dict[tuple[str, str], dict[str, Any]] = {
     ("FederationSpec", "partitioned_after_probes"): {"minimum": 1},
     ("FederationSpec", "heal_probes"): {"minimum": 1},
     ("FederationSpec", "lease_duration_second"): {"minimum": 0},
+    ("ArtifactSpec", "name"): {"pattern": "^[a-z0-9]([a-z0-9.-]*[a-z0-9])?$"},
+    ("ArtifactSpec", "gate"): {"enum": list(GATE_MODES)},
+    ("ArtifactEdgeSpec", "before"): {"pattern": "^.+$"},
+    ("ArtifactEdgeSpec", "after"): {"pattern": "^.+$"},
+    ("ArtifactEdgeSpec", "skew"): {"enum": list(SKEW_MODES)},
 }
 
 

@@ -655,6 +655,78 @@ class FederationSpec(_SpecBase):
 
 
 @dataclass
+class ArtifactSpec(_SpecBase):
+    """One artifact of a composable driver stack (device driver, network
+    driver, device plugin, ...) managed as a node of the upgrade DAG."""
+
+    # Unique artifact name (the DAG node id).
+    name: str = ""
+    # DaemonSet selector: pods/DaemonSets carrying these labels belong
+    # to this artifact.
+    match_labels: dict[str, str] = field(default_factory=dict)
+    # Version the roll targets (compared by edges' requires constraints).
+    target_version: str = ""
+    # Per-artifact validation gate run inside the drain window before
+    # the stack may advance past this artifact: "" (none) or
+    # "network-path" (DCN reachability + ICI link state).
+    gate: str = ""
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("artifact: name is required")
+        if not self.match_labels:
+            raise ValidationError(
+                f"artifact {self.name!r}: matchLabels is required"
+            )
+
+
+@dataclass
+class ArtifactEdgeSpec(_SpecBase):
+    """Dependency edge ``before -> after`` of the artifact DAG."""
+
+    # Upstream artifact (must restart no later than `after`).
+    before: str = ""
+    # Downstream artifact.
+    after: str = ""
+    # Version-compatibility constraint the upstream's targetVersion must
+    # satisfy (">=1.2", "==535.104.05", bare version = exact; empty =
+    # unconstrained).  Checked at admission against declared targets.
+    requires: str = ""
+    # "lockstep": both ends restart in the same step of the shared
+    # window.  "pinned-order": `after` may not restart until `before`
+    # is fully synced (and gated, if it declares a gate).
+    skew: str = "lockstep"
+
+    def validate(self) -> None:
+        if not self.before or not self.after:
+            raise ValidationError(
+                "artifact edge: before and after are required"
+            )
+
+
+@dataclass
+class ArtifactDAGSpec(_SpecBase):
+    """The policy's composable driver stack: artifacts + edges.
+
+    Structural validation (cycles, dangling edges, skew conflicts,
+    unsatisfiable constraints) lives in
+    :class:`k8s_operator_libs_tpu.artifacts.dag.ArtifactDAG` and runs
+    through ``_validate_feasibility`` — an invalid stack rejects the
+    policy at admission.  A single-item stack is the classic
+    one-DaemonSet path, byte for byte.
+    """
+
+    items: list[ArtifactSpec] = field(default_factory=list)
+    edges: list[ArtifactEdgeSpec] = field(default_factory=list)
+
+    def validate(self) -> None:
+        for item in self.items:
+            item.validate()
+        for edge in self.edges:
+            edge.validate()
+
+
+@dataclass
 class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     """Slice-aware upgrade policy for TPU node pools.
 
@@ -714,6 +786,10 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
     # gate, global budget, partition-tolerance ladder.  None/disabled =
     # single-cluster behavior unchanged.
     federation: Optional[FederationSpec] = None
+    # Multi-artifact upgrade DAG: the composable driver stack this
+    # policy rolls under ONE cordon/drain window per node.  None or a
+    # single item = the classic one-DaemonSet behavior unchanged.
+    artifacts: Optional[ArtifactDAGSpec] = None
 
     def validate(self) -> None:
         super().validate()
@@ -736,6 +812,8 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
             self.planning.validate()
         if self.federation is not None:
             self.federation.validate()
+        if self.artifacts is not None:
+            self.artifacts.validate()
         seen_pools: set[str] = set()
         for pool in self.pools:
             pool.validate()
@@ -786,6 +864,20 @@ class TPUUpgradePolicySpec(DriverUpgradePolicySpec):
                         f"pool {pool.name!r}: maintenanceWindow.cron "
                         f"{window.cron!r} never opens (plan-infeasible)"
                     )
+        if self.artifacts is not None and self.artifacts.items:
+            # Structural DAG feasibility: cycles, dangling/self edges,
+            # lockstep/pinned-order conflicts, unsatisfiable version
+            # constraints.  Deferred import — artifacts.dag is pure
+            # graph code but api must stay importable standalone.
+            from k8s_operator_libs_tpu.artifacts.dag import (
+                ArtifactDAG,
+                ArtifactDAGError,
+            )
+
+            try:
+                ArtifactDAG.from_spec(self.artifacts).validate()
+            except ArtifactDAGError as e:
+                raise ValidationError(f"artifacts: {e}") from e
 
 
 # Nested-type registry for from_dict (maps (class, field) -> spec type).
@@ -804,8 +896,11 @@ _NESTED_TYPES: dict[tuple[str, str], Any] = {
     ("TPUUpgradePolicySpec", "planning"): PlanningSpec,
     ("TPUUpgradePolicySpec", "federation"): FederationSpec,
     ("FederationSpec", "canary"): FederationCanarySpec,
+    ("TPUUpgradePolicySpec", "artifacts"): ArtifactDAGSpec,
     # List-of-nested: from_dict maps each element through the type.
     ("TPUUpgradePolicySpec", "pools"): PoolSpec,
     ("FederationSpec", "clusters"): FederationClusterSpec,
+    ("ArtifactDAGSpec", "items"): ArtifactSpec,
+    ("ArtifactDAGSpec", "edges"): ArtifactEdgeSpec,
     ("PoolSpec", "maintenance_window"): MaintenanceWindowSpec,
 }

@@ -1,0 +1,93 @@
+"""Per-artifact validation gates inside the drain window.
+
+A ``network-path`` gated artifact (typically the network driver) may not
+be counted synced — and the stack may not advance past its restart step
+— until the data paths it owns are verified back: DCN reachability and
+ICI link state, the fused probe battery's network-path checks
+(:func:`k8s_operator_libs_tpu.health.fused.run_network_path_checks`).
+
+The engine consults a duck-typed prober: any object with
+``probe(group, artifact_name) -> GateResult``-shaped return (``.passed``
+bool + ``.detail`` str).  With no prober configured the gate passes
+vacuously — the fake tier and unit tests run without JAX devices, and
+a cluster operator opts into real gating by wiring a prober exactly the
+way validation probers are wired today.  Gate verdicts are *in-memory
+only*: a controller restart re-probes, which is the safe direction
+(re-verifying a link costs milliseconds warm; trusting a stale verdict
+could advance a stack over a dead link).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from k8s_operator_libs_tpu.consts import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class GateResult:
+    """Verdict of one artifact gate probe."""
+
+    passed: bool
+    detail: str = ""
+    # Per-check name -> ok, for events/metrics.
+    checks: dict[str, bool] = field(default_factory=dict)
+
+
+class NetworkPathGateProber:
+    """Gate prober backed by the fused battery's network-path checks.
+
+    ``runner`` is injected for tests (and for agent-side transports);
+    the default lazily imports :mod:`health.fused` so the controller
+    process never pays a JAX import unless a gated artifact exists AND
+    this prober is wired.
+    """
+
+    def __init__(self, runner=None, expected_processes: Optional[int] = None):
+        self._runner = runner
+        self._expected_processes = expected_processes
+
+    def _run(self):
+        if self._runner is not None:
+            return self._runner()
+        import jax  # deferred: only a wired prober pays this
+
+        from k8s_operator_libs_tpu.health.fused import (
+            run_network_path_checks,
+        )
+
+        return run_network_path_checks(
+            jax.devices(), expected_processes=self._expected_processes
+        )
+
+    def probe(self, group, artifact_name: str) -> GateResult:
+        """Fail-closed: an infrastructure fault is gate-not-passed
+        (the stack simply holds at this step and re-probes next pass),
+        never gate-passed."""
+        try:
+            results = list(self._run())
+        except Exception as e:  # noqa: BLE001 — hold the gate, don't crash
+            logger.warning(
+                "network-path gate probe for artifact %s of group %s "
+                "failed to run: %s",
+                artifact_name,
+                getattr(group, "id", group),
+                e,
+            )
+            return GateResult(False, f"probe error: {e}")
+        checks = {r.name: bool(r.ok) for r in results}
+        failed = [r for r in results if not r.ok]
+        if failed:
+            return GateResult(
+                False,
+                "; ".join(f"{r.name}: {r.detail}" for r in failed),
+                checks,
+            )
+        return GateResult(
+            True,
+            ", ".join(sorted(checks)) + " verified",
+            checks,
+        )

@@ -336,6 +336,105 @@ def _local_shard_rows(out) -> list[tuple[int, np.ndarray]]:
     return rows
 
 
+# Problem sizes for the network-path battery: the smallest fused program
+# that still exercises every ICI link and the cross-host launch path.
+# Tiny on purpose — the network-path gate runs per artifact step inside
+# the drain window, so it must cost milliseconds warm; it shares the
+# topology-keyed compile cache with the full battery (distinct key, so
+# neither evicts the other).
+NETWORK_MATMUL_N = 128
+NETWORK_HBM_MIB = 1
+NETWORK_ALLREDUCE_ELEMS = 8
+
+
+def run_network_path_checks(
+    devices: Sequence[jax.Device],
+    expected_processes: Optional[int] = None,
+) -> list[CheckResult]:
+    """Network-path checks gating the networking artifact's edge:
+    ``dcn_reachability`` + ``ici_link_state``.
+
+    A multi-artifact stack restarts the network driver *inside* the
+    node's single drain window; before the stack may advance past that
+    artifact the data paths it owns must be back.  Two checks:
+
+    - **dcn_reachability** — every expected process (host) is visible
+      through the distributed runtime.  DCN is the cross-host network;
+      a host that cannot be enumerated cannot be reached.  Pure
+      metadata, zero compile.
+    - **ici_link_state** — the fused battery's ring ``ppermute`` at
+      network-probe sizes: every directed ICI link carries one value
+      and the receiver verifies it exactly.  Reuses the same fused
+      program (small problem sizes, own compile-cache key), so warm
+      gates pay one tiny dispatch.
+
+    Returns CheckResults in the battery's conventions; raises on
+    infrastructure faults (caller treats that as gate-not-passed, never
+    as gate-passed)."""
+    devs = list(devices)
+    results: list[CheckResult] = []
+
+    t0 = time.perf_counter()
+    visible = jax.process_count()
+    want = expected_processes if expected_processes else visible
+    dcn_ms = (time.perf_counter() - t0) * 1e3
+    if visible >= want:
+        results.append(
+            CheckResult(
+                "dcn_reachability",
+                True,
+                dcn_ms,
+                f"all {want} expected process(es) visible over DCN "
+                f"({visible} enumerated)",
+                {"expected": float(want), "visible": float(visible)},
+            )
+        )
+    else:
+        results.append(
+            CheckResult(
+                "dcn_reachability",
+                False,
+                dcn_ms,
+                f"only {visible} of {want} expected process(es) visible "
+                "over DCN",
+                {"expected": float(want), "visible": float(visible)},
+            )
+        )
+
+    ring = [
+        r
+        for r in run_fused_battery(
+            devs,
+            matmul_n=NETWORK_MATMUL_N,
+            hbm_mib=NETWORK_HBM_MIB,
+            allreduce_elems=NETWORK_ALLREDUCE_ELEMS,
+        )
+        if r.name == "ici_ring"
+    ]
+    if ring:
+        src = ring[0]
+        results.append(
+            CheckResult(
+                "ici_link_state",
+                src.ok,
+                src.latency_ms,
+                src.detail,
+                dict(src.metrics),
+            )
+        )
+    else:  # skip_ici path cannot be taken here, but stay fail-closed
+        results.append(
+            CheckResult(
+                "ici_link_state",
+                False,
+                0.0,
+                "fused battery returned no ring verdict",
+                {},
+            )
+        )
+    return results
+
+
 def run_fused_battery(
     devices: Sequence[jax.Device],
     matmul_n: int = 4096,

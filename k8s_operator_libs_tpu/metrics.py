@@ -429,6 +429,42 @@ class UpgradeMetrics:
             "Mean per-delta view apply latency in microseconds "
             "(runs under the informer lock; must stay O(1))",
         )
+        # Multi-artifact stack surface (artifacts/ + the engine's
+        # POD_RESTART_REQUIRED stepping; absent on single-artifact
+        # policies, where the DAG of size 1 IS the classic path).
+        r.describe(
+            "artifact_synced_nodes",
+            "Nodes whose pod for this artifact is at the target "
+            "revision (vacuously synced nodes included)",
+            "artifact",
+        )
+        r.describe(
+            "artifact_nodes",
+            "Nodes in groups currently stepping through this artifact",
+            "artifact",
+        )
+        r.describe(
+            "artifact_skew_holds_total",
+            "Pod restarts withheld because a pinned-order edge put the "
+            "artifact at a later level than the group's cursor",
+            "artifact",
+        )
+        r.describe(
+            "artifact_gate_holds_total",
+            "Times an artifact's network-path gate held the stack at "
+            "its edge (probe failed or errored; fail-closed)",
+            "artifact",
+        )
+        r.describe(
+            "artifact_rollbacks_total",
+            "Multi-artifact rollbacks unwound in reverse topological "
+            "order after a crash-looping artifact pod",
+        )
+        r.describe(
+            "artifact_shared_window_savings_total",
+            "Node cordon/drain windows avoided by rolling the whole "
+            "stack inside one window (nodes x extra artifacts)",
+        )
         # Fused probe-battery surface (health.fused; absent when the
         # controller never probed in-process, e.g. NodeReportProber-only
         # deployments where the agents run the battery instead).
@@ -742,6 +778,33 @@ class UpgradeMetrics:
             "quarantine_cycle_demotions_total",
             getattr(manager, "quarantine_cycle_demotions", 0),
         )
+        # Multi-artifact stack surface (absent on injected fakes and a
+        # no-op for single-artifact policies, whose progress dict stays
+        # empty).  Per-artifact gauges republish as a snapshot so a
+        # finished stack's series don't linger.
+        progress = getattr(manager, "artifact_progress", None)
+        if progress is not None:
+            r.clear("artifact_synced_nodes")
+            r.clear("artifact_nodes")
+            for name, (synced, total) in sorted(progress.items()):
+                r.set("artifact_synced_nodes", synced, artifact=name)
+                r.set("artifact_nodes", total, artifact=name)
+            for name, count in sorted(
+                getattr(manager, "artifact_skew_holds", {}).items()
+            ):
+                r.set("artifact_skew_holds_total", count, artifact=name)
+            for name, count in sorted(
+                getattr(manager, "artifact_gate_holds", {}).items()
+            ):
+                r.set("artifact_gate_holds_total", count, artifact=name)
+            r.set(
+                "artifact_rollbacks_total",
+                getattr(manager, "artifact_rollbacks_total", 0),
+            )
+            r.set(
+                "artifact_shared_window_savings_total",
+                getattr(manager, "artifact_window_savings", 0),
+            )
         negotiations = getattr(manager, "elastic_negotiations", None)
         if negotiations is not None:
             for outcome, count in sorted(negotiations.items()):

@@ -63,6 +63,11 @@ KIND_GROUP = "group"
 KIND_NODE = "node"
 KIND_PHASE = "phase"
 KIND_WAIT = "wait"
+# Per-artifact step inside a phase (multi-artifact stacks): nested under
+# the group's open phase span.  Deliberately NOT a makespan bucket —
+# critical.py buckets only PHASE/WAIT kinds, so the phase spans keep
+# summing exactly to the makespan with artifact nesting present.
+KIND_ARTIFACT = "artifact"
 
 # Wait-span reasons (the critical-path buckets key off these).
 WAIT_BUDGET = "budget"
@@ -220,6 +225,8 @@ class TraceRecorder:
         self._group_state: dict[str, str] = {}
         # group key -> open phase span id
         self._group_phase: dict[str, str] = {}
+        # (group key, artifact name) -> open artifact span id
+        self._group_artifact: dict[tuple[str, str], str] = {}
         # (group key, wait reason) -> open wait span id
         self._group_wait: dict[tuple[str, str], str] = {}
         # node name -> (group key, open rung-wait span id or None)
@@ -343,6 +350,15 @@ class TraceRecorder:
         return span_id if span_id in self._spans else None
 
     def _close_phase_locked(self, group_key: str, now: float) -> None:
+        # Artifact steps nest under the phase: a rotating phase takes its
+        # open artifact spans with it.
+        for (gkey, artifact) in list(self._group_artifact):
+            if gkey != group_key:
+                continue
+            aid = self._group_artifact.pop((gkey, artifact))
+            aspan = self._spans.get(aid)
+            if aspan is not None and aspan.open:
+                aspan.end = now
         span_id = self._group_phase.pop(group_key, None)
         if span_id is not None:
             span = self._spans.get(span_id)
@@ -574,6 +590,52 @@ class TraceRecorder:
                 group_key,
                 span.span_id if span is not None else None,
             )
+
+    @_failopen
+    def artifact_step(
+        self, group_or_nodes, artifact: str, done: bool = False
+    ) -> None:
+        """Multi-artifact stack hook: one nested span per artifact step
+        under the group's OPEN phase span (pod-restart today), opened
+        when the engine starts restarting that artifact's pods and
+        closed when the artifact is fully synced (``done=True``).  The
+        span kind is excluded from makespan bucketing by construction
+        (critical.py walks PHASE/WAIT only), so nesting artifact steps
+        never perturbs the buckets-sum-exactly invariant."""
+        group_key = self._gkey(group_or_nodes)
+        if group_key is None:
+            return
+        with self._lock:
+            if self.trace_id is None:
+                return
+            ts = self._clock()
+            key = (group_key, artifact)
+            open_id = self._group_artifact.get(key)
+            if done:
+                if open_id is not None:
+                    span = self._spans.get(open_id)
+                    if span is not None and span.open:
+                        span.end = ts
+                    del self._group_artifact[key]
+                return
+            if open_id is not None:
+                span = self._spans.get(open_id)
+                if span is not None and span.open:
+                    return  # idempotent re-issue while the step runs
+            parent = self._group_phase.get(group_key)
+            if parent is None or parent not in self._spans:
+                parent = self._group_span_id(group_key)
+            if parent is None:
+                return
+            span = self._new_span(
+                f"{parent}/artifact:{artifact}",
+                parent,
+                KIND_ARTIFACT,
+                f"artifact:{artifact}",
+                ts,
+            )
+            if span is not None:
+                self._group_artifact[key] = span.span_id
 
     @_failopen
     def note_gate(self, group_or_nodes, detail: str) -> None:

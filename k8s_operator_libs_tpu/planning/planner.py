@@ -29,6 +29,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from k8s_operator_libs_tpu.artifacts.dag import artifact_dag_of
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.fleet.scheduler import (
     group_sort_key,
@@ -270,6 +271,17 @@ def _group_duration_s(
     the assumption-level (static or twin-measured) clocks."""
     clocks = assumptions.pool_clocks.get(pool_name or "") or assumptions.clocks
     total = clocks.cordon_s + clocks.uncordon_s + clocks.pod_restart_s
+    # Multi-artifact stacks step through their serialized levels inside
+    # the ONE shared window: each extra level costs another pod-restart
+    # clock, while cordon/drain/validation/uncordon stay amortized —
+    # skew-pinned edges therefore serialize WITHIN a wave, they never
+    # add waves.
+    try:
+        dag = artifact_dag_of(policy)
+    except Exception:
+        dag = None
+    if dag is not None:
+        total += (dag.serialized_steps() - 1) * clocks.pod_restart_s
     total += clocks.validation_s
     if policy.wait_for_completion is not None:
         total += clocks.wait_for_jobs_s

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from k8s_operator_libs_tpu.artifacts.dag import artifact_dag_of
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s import (
     ContainerStatus,
@@ -118,22 +119,41 @@ class TwinResult:
 
 
 def clone_cluster(
-    source_client, namespace: str, driver_labels: dict[str, str]
+    source_client,
+    namespace: str,
+    driver_labels: dict[str, str],
+    artifact_selectors: Optional[dict[str, dict[str, str]]] = None,
 ) -> FakeCluster:
     """Deep-copy every driver-managed object the engine reads — nodes,
-    driver DaemonSets + their ControllerRevisions, driver pods — into a
-    fresh FakeCluster.  Read-only against the source."""
+    driver DaemonSets + their ControllerRevisions, driver pods, and
+    (multi-artifact stacks) every artifact selector's DaemonSets and
+    pods — into a fresh FakeCluster.  Read-only against the source."""
     twin = FakeCluster()
     for node in source_client.list_nodes():
         twin.create_node(copy.deepcopy(node))
-    for ds in source_client.list_daemon_sets(namespace, driver_labels):
-        twin.create_daemon_set(copy.deepcopy(ds))
+    seen_ds: set = set()
+    seen_pods: set = set()
+    selector_sets = [driver_labels] + list(
+        (artifact_selectors or {}).values()
+    )
+    for sel in selector_sets:
+        for ds in source_client.list_daemon_sets(namespace, sel):
+            key = (ds.namespace, ds.name)
+            if key in seen_ds:
+                continue
+            seen_ds.add(key)
+            twin.create_daemon_set(copy.deepcopy(ds))
     for rev in source_client.list_controller_revisions(namespace):
         twin.create_controller_revision(copy.deepcopy(rev))
-    for pod in source_client.list_pods(
-        namespace=namespace, match_labels=driver_labels
-    ):
-        twin.create_pod(copy.deepcopy(pod))
+    for sel in selector_sets:
+        for pod in source_client.list_pods(
+            namespace=namespace, match_labels=sel
+        ):
+            key = (pod.namespace, pod.name)
+            if key in seen_pods:
+                continue
+            seen_pods.add(key)
+            twin.create_pod(copy.deepcopy(pod))
     return twin
 
 
@@ -224,7 +244,27 @@ def run_twin(
     )
 
     keys = keys or UpgradeKeys()
-    twin = clone_cluster(source_client, namespace, driver_labels)
+    # Multi-artifact policies: the twin must hold every artifact's
+    # DaemonSet + pods, or the engine would see them vacuously synced
+    # and skip the serialized steps the plan is meant to validate.
+    try:
+        dag = artifact_dag_of(policy)
+    except Exception:
+        dag = None
+    artifact_selectors = None
+    if dag is not None:
+        primary = dag.primary()
+        artifact_selectors = {
+            name: dict(dag.artifact(name).match_labels)
+            for name in dag.topo_order()
+            if name != primary
+        }
+    twin = clone_cluster(
+        source_client,
+        namespace,
+        driver_labels,
+        artifact_selectors=artifact_selectors,
+    )
     policy = copy.deepcopy(policy)
 
     clock = AcceleratedClock()

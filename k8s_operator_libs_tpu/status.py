@@ -253,6 +253,64 @@ def elastic_health(metrics_url: str, fetch=None) -> Optional[dict]:
     return out or None
 
 
+def artifact_health(metrics_url: str, fetch=None) -> Optional[dict]:
+    """Multi-artifact stack progress from the controller's /metrics.
+
+    Returns None when the artifact family is absent (single-artifact
+    policy — the classic path publishes no per-artifact series), an
+    ``{"error": ...}`` dict when the endpoint is unreachable."""
+    try:
+        text = _metrics_text(metrics_url, fetch)
+    except Exception as e:  # noqa: BLE001 — status must render regardless
+        return {"error": f"metrics unreachable: {e}"}
+    out: dict = {}
+    artifacts: dict[str, dict] = {}
+
+    def _row(labels: str) -> Optional[dict]:
+        name = labels.split('artifact="', 1)
+        if len(name) != 2:
+            return None
+        return artifacts.setdefault(name[1].split('"', 1)[0], {})
+
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        labels = ""
+        if "{" in name:
+            name, _, labels = name.partition("{")
+        if not name.startswith(PREFIX + "_"):
+            continue
+        short = name[len(PREFIX) + 1 :]
+        try:
+            val = float(value)
+        except ValueError:
+            continue
+        if short == "artifact_synced_nodes":
+            row = _row(labels)
+            if row is not None:
+                row["synced"] = int(val)
+        elif short == "artifact_nodes":
+            row = _row(labels)
+            if row is not None:
+                row["nodes"] = int(val)
+        elif short == "artifact_skew_holds_total":
+            row = _row(labels)
+            if row is not None:
+                row["skewHolds"] = int(val)
+        elif short == "artifact_gate_holds_total":
+            row = _row(labels)
+            if row is not None:
+                row["gateHolds"] = int(val)
+        elif short == "artifact_rollbacks_total":
+            out["rollbacks"] = int(val)
+        elif short == "artifact_shared_window_savings_total":
+            out["sharedWindowSavings"] = int(val)
+    if artifacts:
+        out["artifacts"] = artifacts
+    return out if artifacts else None
+
+
 def write_plane_health(metrics_url: str, fetch=None) -> Optional[dict]:
     """Transactional write-plane health from the controller's /metrics.
 
@@ -763,6 +821,9 @@ def gather(
         elastic = elastic_health(metrics_url, fetch=metrics_fetch)
         if elastic is not None:
             out["elasticCoordination"] = elastic
+        artifact = artifact_health(metrics_url, fetch=metrics_fetch)
+        if artifact is not None:
+            out["artifactStack"] = artifact
         plane = write_plane_health(metrics_url, fetch=metrics_fetch)
         if plane is not None:
             out["writePlane"] = plane
@@ -983,6 +1044,30 @@ def render(status: dict) -> str:
                 f"up {int(res.get('up', 0))} "
                 f"(last {elastic.get('lastResizeSeconds', 0.0):.1f}s)"
             )
+    artifact = status.get("artifactStack")
+    if artifact is not None:
+        lines.append("")
+        if "error" in artifact:
+            lines.append(f"artifact stack: {artifact['error']}")
+        else:
+            lines.append(
+                f"artifact stack: "
+                f"{int(artifact.get('sharedWindowSavings', 0))} shared-"
+                f"window saving(s), "
+                f"{int(artifact.get('rollbacks', 0))} rollback(s)"
+            )
+            for name, row in sorted(
+                (artifact.get("artifacts") or {}).items()
+            ):
+                bits = [
+                    f"  {name}: {int(row.get('synced', 0))}/"
+                    f"{int(row.get('nodes', 0))} node(s) synced"
+                ]
+                if row.get("skewHolds"):
+                    bits.append(f"{int(row['skewHolds'])} skew hold(s)")
+                if row.get("gateHolds"):
+                    bits.append(f"{int(row['gateHolds'])} gate hold(s)")
+                lines.append(" | ".join(bits))
     plane = status.get("writePlane")
     if plane is not None:
         lines.append("")

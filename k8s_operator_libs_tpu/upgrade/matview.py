@@ -43,11 +43,16 @@ import time
 from collections import Counter
 from typing import Callable, Optional
 
+from k8s_operator_libs_tpu.artifacts.dag import artifact_dag_of
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.k8s.objects import deep_copy
 from k8s_operator_libs_tpu.k8s.selectors import matches_labels
 from k8s_operator_libs_tpu.topology.slices import slice_info_for_node
+from k8s_operator_libs_tpu.upgrade.pod_manager import (
+    POD_CONTROLLER_REVISION_HASH_LABEL_KEY,
+)
 from k8s_operator_libs_tpu.upgrade.types import (
+    ArtifactNodeState,
     ClusterUpgradeState,
     NodeUpgradeState,
 )
@@ -80,7 +85,15 @@ class NodeRow:
     (replace-on-write: safe to hold, never mutated) plus the interned
     state-label value the pool groups by."""
 
-    __slots__ = ("name", "pool", "state", "node", "pods")
+    __slots__ = (
+        "name",
+        "pool",
+        "state",
+        "node",
+        "pods",
+        "extra_pods",
+        "artifact_hashes",
+    )
 
     def __init__(self, name: str, pool: str, state: str, node) -> None:
         self.name = name
@@ -90,6 +103,15 @@ class NodeRow:
         # (namespace, name) -> Pod reference; normally exactly one
         # driver pod, transiently two during a pod recreate.
         self.pods: dict = {}
+        # In-namespace pods NOT matching the driver labels — candidate
+        # non-primary artifact pods for multi-artifact stacks.  Kept
+        # separate so ``pods`` (and the resync audit's pair count)
+        # stays driver-only.
+        self.extra_pods: dict = {}
+        # artifact name -> interned controller-revision-hash of that
+        # artifact's pod on this node; maintained once the view has
+        # learned the policy's artifact selectors.
+        self.artifact_hashes: dict = {}
 
 
 class PoolView:
@@ -119,6 +141,7 @@ class MaterializedFleetView:
         namespace: str,
         driver_labels: dict[str, str],
         fresh_fn: Optional[Callable[[], bool]] = None,
+        covers_pod_fn: Optional[Callable[..., bool]] = None,
     ) -> None:
         self.keys = keys
         self.namespace = namespace
@@ -127,12 +150,29 @@ class MaterializedFleetView:
         # returns True (wired to Informer.fresh): a stale feed must
         # fall back to build_state, which has its own staleness path.
         self.fresh_fn = fresh_fn
+        # When set (wired to Informer.covers_pod_query), multi-artifact
+        # policies are served from the view only if the informer's pod
+        # scope provably includes every artifact's selector; otherwise
+        # the feed would silently miss artifact pods and the build must
+        # fall back to build_state (which reads through the live
+        # client).  None = assume NOT covered (fail open).
+        self.covers_pod_fn = covers_pod_fn
         self._lock = threading.Lock()
         self.interner = StringInterner()
         self._pools: dict[str, PoolView] = {}
         self._node_pool: dict[str, str] = {}  # node name -> pool key
         # Driver DaemonSets by uid (references, replace-on-write).
         self._daemon_sets: dict = {}
+        # Non-driver in-namespace DaemonSets by uid — owners of
+        # candidate artifact pods.
+        self._extra_daemon_sets: dict = {}
+        # Learned from the last multi-artifact policy served: artifact
+        # name -> matchLabels, in topological order, primary excluded.
+        # Purely derived config; survives resets (reseed re-applies it).
+        self._artifact_selectors: dict[str, dict[str, str]] = {}
+        # Whether covers_pod_fn vouched for every learned selector —
+        # cached at learn time so the hot path never calls back out.
+        self._artifact_scope_covered = False
         # Driver pods whose node has no row yet (pod delta raced ahead
         # of its node): adopted when the node row appears.  build_state
         # skips such pods too, so limbo pods are invisible to builds.
@@ -166,6 +206,39 @@ class MaterializedFleetView:
             return False
         return matches_labels(pod.labels, self.driver_labels)
 
+    def _pod_class(self, pod) -> Optional[str]:
+        """``"driver"`` for driver-label pods, ``"extra"`` for other
+        in-namespace pods (candidate artifact pods), None for pods the
+        view does not track.  With no namespace configured there is no
+        bound on 'extra', so only driver pods are tracked."""
+        if self.namespace:
+            if pod.namespace != self.namespace:
+                return None
+            if matches_labels(pod.labels, self.driver_labels):
+                return "driver"
+            return "extra"
+        if matches_labels(pod.labels, self.driver_labels):
+            return "driver"
+        return None
+
+    def _refresh_artifact_hashes(self, row) -> None:
+        """Recompute ``row.artifact_hashes`` from its extra pods using
+        the learned selectors (no-op until a multi-artifact policy has
+        been served)."""
+        if not self._artifact_selectors:
+            return
+        hashes: dict = {}
+        for pod in row.extra_pods.values():
+            for name, sel in self._artifact_selectors.items():
+                if matches_labels(pod.labels, sel):
+                    hashes[name] = self.interner.intern(
+                        pod.labels.get(
+                            POD_CONTROLLER_REVISION_HASH_LABEL_KEY, ""
+                        )
+                    )
+                    break
+        row.artifact_hashes = hashes
+
     def _upsert_node(self, node) -> None:
         name = node.metadata.name
         new_pool = self._pool_key_for_node(node)
@@ -179,19 +252,29 @@ class MaterializedFleetView:
                 if row is not None:
                     for pod_key in row.pods:
                         self._pod_node.pop(pod_key, None)
+                    for pod_key in row.extra_pods:
+                        self._pod_node.pop(pod_key, None)
                     # Its pods re-attach under the new pool below.
                     self._limbo_pods.update(row.pods)
+                    self._limbo_pods.update(row.extra_pods)
         pv = self._pool(new_pool)
         row = pv.rows.get(name)
         if row is None:
             row = NodeRow(name, new_pool, self._state_of(node), node)
             pv.rows[name] = row
             # Adopt limbo pods that were waiting for this node.
+            adopted_extra = False
             for pod_key, pod in list(self._limbo_pods.items()):
                 if pod.spec.node_name == name:
                     del self._limbo_pods[pod_key]
-                    row.pods[pod_key] = pod
+                    if self._pod_class(pod) == "driver":
+                        row.pods[pod_key] = pod
+                    else:
+                        row.extra_pods[pod_key] = pod
+                        adopted_extra = True
                     self._pod_node[pod_key] = name
+            if adopted_extra:
+                self._refresh_artifact_hashes(row)
         else:
             row.node = node
             row.state = self._state_of(node)
@@ -212,13 +295,17 @@ class MaterializedFleetView:
         if row is not None:
             for pod_key in row.pods:
                 self._pod_node.pop(pod_key, None)
+            for pod_key in row.extra_pods:
+                self._pod_node.pop(pod_key, None)
             # Keep the pods: a deleted-then-recreated node (repair)
             # re-adopts its still-live driver pods on return.
             self._limbo_pods.update(row.pods)
+            self._limbo_pods.update(row.extra_pods)
 
     def _upsert_pod(self, pod) -> None:
         pod_key = (pod.namespace, pod.metadata.name)
-        if not self._pod_in_scope(pod) or not pod.spec.node_name:
+        cls = self._pod_class(pod)
+        if cls is None or not pod.spec.node_name:
             self._remove_pod_key(pod_key)
             return
         prev_node = self._pod_node.get(pod_key)
@@ -234,7 +321,16 @@ class MaterializedFleetView:
         if row is None:
             self._limbo_pods[pod_key] = pod
             return
-        row.pods[pod_key] = pod
+        if cls == "driver":
+            # A relabel can flip a pod between classes mid-flight.
+            had_extra = row.extra_pods.pop(pod_key, None) is not None
+            row.pods[pod_key] = pod
+            if had_extra:
+                self._refresh_artifact_hashes(row)
+        else:
+            row.pods.pop(pod_key, None)
+            row.extra_pods[pod_key] = pod
+            self._refresh_artifact_hashes(row)
         self._pod_node[pod_key] = node_name
         pv.generation += 1
 
@@ -250,6 +346,8 @@ class MaterializedFleetView:
         row = pv.rows.get(node_name)
         if row is not None:
             row.pods.pop(pod_key, None)
+            if row.extra_pods.pop(pod_key, None) is not None:
+                self._refresh_artifact_hashes(row)
         pv.generation += 1
 
     # -- informer feed -------------------------------------------------------
@@ -264,6 +362,7 @@ class MaterializedFleetView:
                 self._pools.clear()
                 self._node_pool.clear()
                 self._daemon_sets.clear()
+                self._extra_daemon_sets.clear()
                 self._limbo_pods.clear()
                 self._pod_node.clear()
                 self.seeded = False
@@ -288,6 +387,7 @@ class MaterializedFleetView:
                 uid = obj.metadata.uid
                 if op == "delete":
                     self._daemon_sets.pop(uid, None)
+                    self._extra_daemon_sets.pop(uid, None)
                 elif (
                     not self.namespace
                     or obj.namespace == self.namespace
@@ -295,8 +395,14 @@ class MaterializedFleetView:
                     obj.metadata.labels, self.driver_labels
                 ):
                     self._daemon_sets[uid] = obj
+                    self._extra_daemon_sets.pop(uid, None)
+                elif self.namespace and obj.namespace == self.namespace:
+                    # Candidate artifact-owning DaemonSet.
+                    self._extra_daemon_sets[uid] = obj
+                    self._daemon_sets.pop(uid, None)
                 else:
                     self._daemon_sets.pop(uid, None)
+                    self._extra_daemon_sets.pop(uid, None)
             # ControllerRevision deltas don't touch rows: the engine
             # reads revisions through the (cached) client, and the
             # DeltaRouter already dirties every pool on template churn.
@@ -313,12 +419,16 @@ class MaterializedFleetView:
             self._pools.clear()
             self._node_pool.clear()
             self._daemon_sets.clear()
+            self._extra_daemon_sets.clear()
             self._limbo_pods.clear()
             self._pod_node.clear()
-            for ds in snapshot.list_daemon_sets(
-                self.namespace, self.driver_labels
-            ):
-                self._daemon_sets[ds.metadata.uid] = ds
+            for ds in snapshot.list_daemon_sets(self.namespace):
+                if matches_labels(
+                    ds.metadata.labels, self.driver_labels
+                ):
+                    self._daemon_sets[ds.metadata.uid] = ds
+                elif self.namespace:
+                    self._extra_daemon_sets[ds.metadata.uid] = ds
             for node in snapshot.nodes.values():
                 name = node.metadata.name
                 pool = self._pool_key_for_node(node)
@@ -328,7 +438,8 @@ class MaterializedFleetView:
                 )
                 self._node_pool[name] = pool
             for pod in snapshot.pods.values():
-                if not self._pod_in_scope(pod) or not pod.spec.node_name:
+                cls = self._pod_class(pod)
+                if cls is None or not pod.spec.node_name:
                     continue
                 node_name = pod.spec.node_name
                 pool = self._node_pool.get(node_name)
@@ -340,11 +451,18 @@ class MaterializedFleetView:
                 if row is None:
                     self._limbo_pods[pod_key] = pod
                     continue
-                row.pods[pod_key] = pod
+                if cls == "driver":
+                    row.pods[pod_key] = pod
+                else:
+                    row.extra_pods[pod_key] = pod
                 self._pod_node[pod_key] = node_name
             for pv in self._pools.values():
                 pv.generation += 1
                 pv.valid = True
+                if self._artifact_selectors:
+                    for row in pv.rows.values():
+                        if row.extra_pods:
+                            self._refresh_artifact_hashes(row)
             self.seeded = True
             self.stats["reseeds"] += 1
         self.stats["reseed_last_s_x1000"] = int(
@@ -419,6 +537,25 @@ class MaterializedFleetView:
                             != pod.metadata.resource_version
                         ):
                             mismatches += 1
+                            continue
+                    # Artifact pods are audited only when the feed
+                    # provably carries them — a pod-scoped informer
+                    # never sees them, and counting those as
+                    # mismatches would reseed-churn every resync.
+                    if self._artifact_scope_covered and nus.artifacts:
+                        for ast in nus.artifacts.values():
+                            apod = ast.pod
+                            if apod is None:
+                                continue
+                            row_pod = row.extra_pods.get(
+                                (apod.namespace, apod.metadata.name)
+                            )
+                            if (
+                                row_pod is None
+                                or row_pod.metadata.resource_version
+                                != apod.metadata.resource_version
+                            ):
+                                mismatches += 1
             view_pairs = sum(
                 len(row.pods)
                 for pv in self._pools.values()
@@ -437,6 +574,43 @@ class MaterializedFleetView:
 
     # -- the read path -------------------------------------------------------
 
+    def _artifact_serving_ready(
+        self, selectors: dict[str, dict[str, str]]
+    ) -> bool:
+        """Whether the view can serve a multi-artifact policy with
+        these NON-primary selectors: the informer's pod scope must
+        provably cover every one of them (otherwise artifact pods never
+        reach the feed and the engine would see them all as vacuously
+        synced — the one wrongness the view must never introduce).
+        Learns the selectors as a side effect so ingest can maintain
+        per-row artifact revision hashes."""
+        if not self.namespace or self.covers_pod_fn is None:
+            return False
+        with self._lock:
+            if selectors == self._artifact_selectors:
+                return self._artifact_scope_covered
+        # Coverage depends only on static scope + selectors: computed
+        # once per policy shape, cached, never called on the hot path
+        # (and never under the view lock — ordering doctrine).
+        try:
+            covered = all(
+                self.covers_pod_fn(
+                    namespace=self.namespace, match_labels=sel
+                )
+                for sel in selectors.values()
+            )
+        except Exception:
+            logger.exception("artifact scope probe failed; fail open")
+            covered = False
+        with self._lock:
+            self._artifact_selectors = dict(selectors)
+            self._artifact_scope_covered = covered
+            for pv in self._pools.values():
+                for row in pv.rows.values():
+                    if row.extra_pods or row.artifact_hashes:
+                        self._refresh_artifact_hashes(row)
+        return covered
+
     def build_pool_state(
         self, key: str, policy, manager
     ) -> Optional[ClusterUpgradeState]:
@@ -445,7 +619,25 @@ class MaterializedFleetView:
         they reference, then reuses the manager's own ``_build_groups``
         for byte-identical grouping semantics.  Returns None whenever
         the view cannot prove it is serving current data — the caller
-        must fall back to ``build_state``."""
+        must fall back to ``build_state``.  Multi-artifact policies are
+        served only when the informer feed provably carries every
+        artifact's pods (see :meth:`_artifact_serving_ready`)."""
+        try:
+            dag = artifact_dag_of(policy)
+        except Exception:
+            self.stats["misses_artifact_policy"] += 1
+            return None
+        selectors: dict[str, dict[str, str]] = {}
+        if dag is not None:
+            primary = dag.primary()
+            for name in dag.topo_order():
+                if name != primary:
+                    selectors[name] = dict(
+                        dag.artifact(name).match_labels
+                    )
+            if not self._artifact_serving_ready(selectors):
+                self.stats["misses_artifact_scope"] += 1
+                return None
         with self._lock:
             if not self.seeded:
                 self.stats["misses_unseeded"] += 1
@@ -454,20 +646,27 @@ class MaterializedFleetView:
             if pv is None or not pv.valid:
                 self.stats["misses_invalid"] += 1
                 return None
-            # (node ref, [(pod_key, pod ref)]) pairs + the ds refs:
-            # grabbed under the lock, copied outside it.
+            # (node ref, driver pod refs, extra pod refs) triples + the
+            # ds refs: grabbed under the lock, copied outside it.
             rows = [
-                (row.node, list(row.pods.values()))
+                (
+                    row.node,
+                    list(row.pods.values()),
+                    list(row.extra_pods.values()) if dag else (),
+                )
                 for row in pv.rows.values()
             ]
             ds_refs = dict(self._daemon_sets)
+            extra_ds_refs = (
+                dict(self._extra_daemon_sets) if dag else {}
+            )
         if self.fresh_fn is not None and not self.fresh_fn():
             self.stats["misses_stale"] += 1
             return None
         state = ClusterUpgradeState()
         node_states_by_name: dict[str, NodeUpgradeState] = {}
         ds_copies: dict = {}
-        for node_ref, pods in rows:
+        for node_ref, pods, extra_pods in rows:
             node_copy = None
             for pod in pods:
                 if pod.is_orphaned():
@@ -496,6 +695,33 @@ class MaterializedFleetView:
                 state.node_states.setdefault(label_state, []).append(
                     nus
                 )
+            if dag is None or node_copy is None or not extra_pods:
+                continue
+            # Attach non-primary artifacts, mirroring the engine's
+            # _attach_artifacts: pod paired to a DaemonSet matching the
+            # SAME artifact's selector via owner uid; no pod for an
+            # artifact = no entry = vacuously synced.
+            nus = node_states_by_name[node_copy.name]
+            for name, sel in selectors.items():
+                for apod in extra_pods:
+                    if not matches_labels(apod.labels, sel):
+                        continue
+                    ads = None
+                    if not apod.is_orphaned():
+                        uid = apod.metadata.owner_references[0].uid
+                        ref = extra_ds_refs.get(uid)
+                        if ref is not None and matches_labels(
+                            ref.metadata.labels, sel
+                        ):
+                            ads = ds_copies.get(uid)
+                            if ads is None:
+                                ads = deep_copy(ref)
+                                ds_copies[uid] = ads
+                    if nus.artifacts is None:
+                        nus.artifacts = {}
+                    nus.artifacts[name] = ArtifactNodeState(
+                        pod=deep_copy(apod), daemon_set=ads
+                    )
         manager._build_groups(state, node_states_by_name, policy)
         self.stats["pool_builds"] += 1
         return state
@@ -512,6 +738,8 @@ class MaterializedFleetView:
                 ),
                 "interned_strings": len(self.interner),
                 "seeded": self.seeded,
+                "artifact_selectors": len(self._artifact_selectors),
+                "artifact_scope_covered": self._artifact_scope_covered,
                 "apply_avg_us": (
                     (self.apply_total_s / events) * 1e6 if events else 0.0
                 ),

@@ -829,6 +829,9 @@ class ShardedReconciler:
                 namespace,
                 driver_labels,
                 fresh_fn=informer.fresh,
+                covers_pod_fn=getattr(
+                    informer, "covers_pod_query", None
+                ),
             )
             informer.add_change_listener(self.matview.on_store_change)
 

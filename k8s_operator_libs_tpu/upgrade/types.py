@@ -21,6 +21,19 @@ from k8s_operator_libs_tpu.upgrade.consts import (
 
 
 @dataclass
+class ArtifactNodeState:
+    """One non-primary artifact's pod/DaemonSet pair on one node.
+
+    Multi-artifact stacks only: the PRIMARY artifact (first in
+    topological order) keeps riding the classic ``driver_pod`` /
+    ``driver_daemon_set`` fields, so a size-1 DAG never allocates these.
+    """
+
+    pod: Optional[Pod] = None
+    daemon_set: Optional[DaemonSet] = None
+
+
+@dataclass
 class NodeUpgradeState:
     """Mapping between a node, the driver pod on it, and the owning
     DaemonSet (reference upgrade_state.go:38-44)."""
@@ -28,9 +41,16 @@ class NodeUpgradeState:
     node: Node
     driver_pod: Optional[Pod] = None
     driver_daemon_set: Optional[DaemonSet] = None
+    # Multi-artifact stacks: artifact name -> that artifact's pod/DS on
+    # this node (primary artifact excluded — it IS driver_pod above).
+    # None for single-artifact policies, by construction.
+    artifacts: Optional[dict[str, "ArtifactNodeState"]] = None
 
     def is_orphaned_pod(self) -> bool:
         return self.driver_daemon_set is None
+
+    def artifact_state(self, name: str) -> Optional["ArtifactNodeState"]:
+        return (self.artifacts or {}).get(name)
 
 
 @dataclass

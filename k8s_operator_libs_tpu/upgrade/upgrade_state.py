@@ -30,6 +30,7 @@ from k8s_operator_libs_tpu.api.v1alpha1 import (
     DriverUpgradePolicySpec,
     TPUUpgradePolicySpec,
 )
+from k8s_operator_libs_tpu.artifacts.dag import artifact_dag_of
 from k8s_operator_libs_tpu.consts import get_logger
 from k8s_operator_libs_tpu.fleet.profiles import generation_of
 from k8s_operator_libs_tpu.fleet.scheduler import (
@@ -82,6 +83,7 @@ from k8s_operator_libs_tpu.upgrade.safe_driver_load_manager import (
 )
 from k8s_operator_libs_tpu.upgrade.stuck import StuckStateDetector
 from k8s_operator_libs_tpu.upgrade.types import (
+    ArtifactNodeState,
     ClusterUpgradeState,
     NodeUpgradeState,
     UpgradeGroup,
@@ -329,6 +331,33 @@ class ClusterUpgradeStateManager:
         # "packed" — packed requires a fresh plan, so a stale anchor
         # reports greedy here even with admissionMode: packed).
         self.admission_mode = "greedy"
+        # Multi-artifact stack bookkeeping (artifacts/), all observe-only
+        # (metrics.py + status CLI read them off the manager the same way
+        # as quarantines_total).  artifact_progress: artifact name ->
+        # (synced member-pods, total member-pods) across the groups the
+        # last pass touched.  artifact_skew_holds: lifetime count of
+        # restart steps held back by a pinned-order edge, per artifact.
+        # artifact_gate_holds: lifetime count of passes an artifact's
+        # network-path gate answered not-passed.  Window savings: nodes x
+        # (artifacts - 1) cordon/drain windows the shared window avoided,
+        # accumulated when a multi-artifact group leaves POD_RESTART.
+        self.artifact_progress: dict[str, tuple[int, int]] = {}
+        self.artifact_skew_holds: dict[str, int] = {}
+        self.artifact_gate_holds: dict[str, int] = {}
+        self.artifact_window_savings = 0
+        self.artifact_rollbacks_total = 0
+        # Gate prober for network-path gated artifacts: duck-typed
+        # `probe(group, artifact_name) -> .passed/.detail` (see
+        # artifacts/gates.py).  None = gates pass vacuously (fake tier,
+        # unit tests, clusters without a wired prober).
+        self.artifact_gate_prober = None
+        # Healthy gate verdicts cached per (group id, artifact) for the
+        # life of the step — in-memory only, a restarted controller
+        # re-probes (the safe direction).
+        self._artifact_gate_ok: set[tuple[str, str]] = set()
+        # (group id, artifact) pairs already warned about an ongoing
+        # gate hold: one ArtifactGateHeld Warning per episode.
+        self._artifact_gate_warned: set[tuple[str, str]] = set()
         # Roll tracing (obs/trace.py): every roll becomes a causal span
         # tree recorded at the engine's existing choke points.  Observe
         # -only and fail-open by contract — the recorder can never block
@@ -870,8 +899,63 @@ class ClusterUpgradeStateManager:
             label_state = node.labels.get(self.keys.state_label, "")
             state.node_states.setdefault(label_state, []).append(nus)
 
+        self._attach_artifacts(
+            node_states_by_name, namespace, policy, scope_nodes
+        )
         self._build_groups(state, node_states_by_name, policy)
         return state
+
+    def _attach_artifacts(
+        self,
+        node_states_by_name: dict[str, NodeUpgradeState],
+        namespace: str,
+        policy: Optional[DriverUpgradePolicySpec],
+        scope_nodes: Optional[set[str]],
+    ) -> None:
+        """Resolve every NON-primary artifact's pods/DaemonSets onto the
+        node states (multi-artifact policies only).
+
+        The primary artifact — first in topological order — is the
+        classic driver DaemonSet and already rides ``driver_pod`` /
+        ``driver_daemon_set``: its matchLabels are the ``driver_labels``
+        this build ran with.  Lookups go through ``self.client``, never
+        the informer snapshot: the controller may scope its pod informer
+        to the driver labels, and a scoped cache would silently miss the
+        other artifacts' pods (CachedKubeClient falls through to the
+        live client for uncovered queries).  A node with no pod for an
+        artifact gets no entry — the engine treats it as vacuously
+        synced, matching how the classic path treats a node its
+        DaemonSet does not schedule onto."""
+        dag = artifact_dag_of(policy)
+        if dag is None:
+            return
+        primary = dag.primary()
+        for name in dag.topo_order():
+            if name == primary:
+                continue
+            art = dag.artifact(name)
+            labels = dict(art.match_labels)
+            dss = {
+                ds.metadata.uid: ds
+                for ds in self.client.list_daemon_sets(namespace, labels)
+            }
+            for pod in self.client.list_pods(
+                namespace=namespace, match_labels=labels
+            ):
+                node_name = pod.spec.node_name
+                if not node_name:
+                    continue
+                if scope_nodes is not None and node_name not in scope_nodes:
+                    continue
+                nus = node_states_by_name.get(node_name)
+                if nus is None:
+                    continue
+                ds = None
+                if not pod.is_orphaned():
+                    ds = dss.get(pod.metadata.owner_references[0].uid)
+                if nus.artifacts is None:
+                    nus.artifacts = {}
+                nus.artifacts[name] = ArtifactNodeState(pod=pod, daemon_set=ds)
 
     def _build_groups(
         self,
@@ -1093,8 +1177,12 @@ class ClusterUpgradeStateManager:
                 ledger.trace_hook = self._note_budget
             except AttributeError:
                 pass
-        self.process_done_or_unknown_groups(current_state, UpgradeState.UNKNOWN)
-        self.process_done_or_unknown_groups(current_state, UpgradeState.DONE)
+        self.process_done_or_unknown_groups(
+            current_state, UpgradeState.UNKNOWN, policy
+        )
+        self.process_done_or_unknown_groups(
+            current_state, UpgradeState.DONE, policy
+        )
         if self.trace_recorder is not None:
             # Wave boundary: groups the coming admission pass charges
             # share one wave span per pool in the roll trace.
@@ -1128,7 +1216,7 @@ class ClusterUpgradeStateManager:
         )
         self.process_drain_groups(current_state, policy.drain_spec)
         self.process_pod_restart_groups(
-            current_state, validation_active, pipeline=pipeline
+            current_state, validation_active, pipeline=pipeline, policy=policy
         )
         self.process_upgrade_failed_groups(current_state, validation_active)
         self.process_validation_required_groups(current_state, validation_active)
@@ -1171,10 +1259,20 @@ class ClusterUpgradeStateManager:
     # -- processors ----------------------------------------------------------
 
     def process_done_or_unknown_groups(
-        self, state: ClusterUpgradeState, state_name: UpgradeState
+        self,
+        state: ClusterUpgradeState,
+        state_name: UpgradeState,
+        policy: Optional[DriverUpgradePolicySpec] = None,
     ) -> None:
         """Decide upgrade-required vs done (upgrade_state.go:488-550).
-        A slice requires upgrade if ANY host needs it — it moves whole."""
+        A slice requires upgrade if ANY host needs it — it moves whole.
+
+        Multi-artifact stacks: an out-of-sync NON-primary artifact also
+        requires the upgrade — the whole stack rides the one window, so
+        a network-driver bump re-enters the same machine the libtpu bump
+        uses (size-1 DAGs take the classic predicate untouched)."""
+        dag = artifact_dag_of(policy)
+        secondary = dag.topo_order()[1:] if dag is not None else []
         for group in state.groups_in(state_name):
             requires = False
             for member in group.members:
@@ -1183,6 +1281,10 @@ class ClusterUpgradeStateManager:
                     member.node
                 ):
                     requires = True
+                for name in secondary:
+                    a_synced, a_orphaned = self._artifact_in_sync(member, name)
+                    if not a_synced and not a_orphaned:
+                        requires = True
             if self.safe_driver_load_manager.is_group_waiting_for_safe_driver_load(
                 group
             ):
@@ -1516,6 +1618,7 @@ class ClusterUpgradeStateManager:
         state: ClusterUpgradeState,
         validation_active: Optional[bool] = None,
         pipeline: bool = False,
+        policy: Optional[DriverUpgradePolicySpec] = None,
     ) -> None:
         """Restart outdated driver pods; advance fully-recovered groups
         (upgrade_state.go:764-831).
@@ -1524,10 +1627,25 @@ class ClusterUpgradeStateManager:
         fully-synced group is uncordoned ON ENTRY to validation: the
         workload is readmitted while the health gate runs, so the slice
         stops counting against parallel/unavailability budgets and the
-        next slice's drain overlaps this one's validation."""
+        next slice's drain overlaps this one's validation.
+
+        Multi-artifact stacks (``policy.artifacts``, >1 item) step the
+        group's artifacts through this SAME state — topological order,
+        one restart step per pinned-order level, per-artifact gates —
+        so the whole stack amortizes the one cordon/drain/uncordon
+        window (and the one budget charge) the group already holds.
+        Size-1 DAGs never enter that branch: the classic body below is
+        the single-artifact path, unchanged."""
         if validation_active is None:
             validation_active = self.is_validation_enabled()
+        dag = artifact_dag_of(policy)
+        progress: dict[str, list[int]] = {}
         for group in state.groups_in(UpgradeState.POD_RESTART_REQUIRED):
+            if dag is not None:
+                self._process_multi_artifact_restart(
+                    group, dag, validation_active, pipeline, progress
+                )
+                continue
             pods_to_restart: list[Pod] = []
             synced_members: list[NodeUpgradeState] = []
             for member in group.members:
@@ -1563,36 +1681,223 @@ class ClusterUpgradeStateManager:
                 continue
             if len(synced_members) != group.size():
                 continue  # restarts pending; next pass re-checks
-            # Every pod carries the new template: the slice is quiesced, so
-            # release any held driver loads in one batch (safe-load protocol,
-            # upgrade_state.go:783).
-            self.safe_driver_load_manager.unblock_group_loading(group)
-            if all(self._is_driver_pod_in_sync(m) for m in group.members):
-                if validation_active:
-                    if pipeline:
-                        # Optimistic uncordon: readmit the workload now;
-                        # hosts that started cordoned stay cordoned.
-                        key = self.keys.initial_state_annotation
-                        self.cordon_manager.uncordon_nodes(
-                            [
-                                m.node
-                                for m in group.members
-                                if key not in m.node.annotations
-                            ]
-                        )
-                        if self.budget_ledger is not None:
-                            # Hosts are schedulable while the gate runs:
-                            # free the fleet-wide charge so the next
-                            # slice's upgrade overlaps this validation
-                            # (the local-slot path does the same via
-                            # _group_validating_schedulable).  A timeout
-                            # re-charges through on_pipeline_recordon.
-                            self.budget_ledger.release(group.id)
-                    self.provider.change_nodes_upgrade_state(
-                        group.nodes, UpgradeState.VALIDATION_REQUIRED
-                    )
+            self._advance_restart_synced_group(
+                group,
+                validation_active,
+                pipeline,
+                all(self._is_driver_pod_in_sync(m) for m in group.members),
+            )
+        if dag is not None:
+            # Last-pass per-artifact progress gauge (metrics/status):
+            # synced member-pods / total member-pods across the groups
+            # currently inside their restart window.
+            self.artifact_progress = {
+                name: (row[0], row[1]) for name, row in progress.items()
+            }
+
+    def _advance_restart_synced_group(
+        self,
+        group: UpgradeGroup,
+        validation_active: bool,
+        pipeline: bool,
+        all_ready: bool,
+    ) -> None:
+        """Shared tail of the pod-restart processor: every pod carries
+        the new template, so release held driver loads in one batch
+        (safe-load protocol, upgrade_state.go:783) and — once every pod
+        is also Running+Ready — hand the group to validation/uncordon."""
+        self.safe_driver_load_manager.unblock_group_loading(group)
+        if not all_ready:
+            return
+        if validation_active:
+            if pipeline:
+                # Optimistic uncordon: readmit the workload now;
+                # hosts that started cordoned stay cordoned.
+                key = self.keys.initial_state_annotation
+                self.cordon_manager.uncordon_nodes(
+                    [
+                        m.node
+                        for m in group.members
+                        if key not in m.node.annotations
+                    ]
+                )
+                if self.budget_ledger is not None:
+                    # Hosts are schedulable while the gate runs:
+                    # free the fleet-wide charge so the next
+                    # slice's upgrade overlaps this validation
+                    # (the local-slot path does the same via
+                    # _group_validating_schedulable).  A timeout
+                    # re-charges through on_pipeline_recordon.
+                    self.budget_ledger.release(group.id)
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.VALIDATION_REQUIRED
+            )
+        else:
+            self._update_group_to_uncordon_or_done(group)
+
+    def _process_multi_artifact_restart(
+        self,
+        group: UpgradeGroup,
+        dag,
+        validation_active: bool,
+        pipeline: bool,
+        progress: dict[str, list[int]],
+    ) -> None:
+        """Step one group's artifact stack inside its held window.
+
+        The group sits in POD_RESTART_REQUIRED across however many
+        passes the stack needs; its cordon/drain already happened ONCE
+        and its single BudgetLedger charge stays held — this method
+        performs only pod restarts and in-memory gate probes, so every
+        additional artifact costs exactly its own DaemonSet's pod
+        restarts in API writes and nothing else.
+
+        Stepping: the cursor is the earliest (topological) restart step
+        with any unsynced-or-ungated artifact; only cursor-step
+        artifacts restart this pass, later pinned-order steps hold
+        (counted per artifact in ``artifact_skew_holds``).  A synced
+        artifact whose pod crash-loops fails the group, unwinding in
+        REVERSE topological order (events per step, then the classic
+        POD_RESTART_REQUIRED -> FAILED edge).  Crash resume is free:
+        the cursor derives from observed pod revision hashes, so a
+        fresh controller lands on the exact in-flight step with zero
+        extra durable writes."""
+        levels = dag.levels()
+        order = dag.topo_order()
+        primary = order[0]
+
+        def sync_of(member: NodeUpgradeState, name: str) -> tuple[bool, bool]:
+            if name == primary:
+                return self._pod_in_sync_with_ds(member)
+            return self._artifact_in_sync(member, name)
+
+        def pod_of(member: NodeUpgradeState, name: str) -> Optional[Pod]:
+            if name == primary:
+                return member.driver_pod
+            art = member.artifact_state(name)
+            return art.pod if art is not None else None
+
+        restartable: dict[str, list[Pod]] = {}
+        synced_count: dict[str, int] = {}
+        failing: dict[str, list[str]] = {}
+        for name in order:
+            pods: list[Pod] = []
+            synced_n = 0
+            crash: list[str] = []
+            for member in group.members:
+                synced, orphaned = sync_of(member, name)
+                pod = pod_of(member, name)
+                if not synced or orphaned:
+                    if pod is not None and not pod.is_terminating():
+                        pods.append(pod)
                 else:
-                    self._update_group_to_uncordon_or_done(group)
+                    synced_n += 1
+                    if pod is not None and self._is_driver_pod_failing(pod):
+                        crash.append(member.node.name)
+            restartable[name] = pods
+            synced_count[name] = synced_n
+            failing[name] = crash
+            row = progress.setdefault(name, [0, 0])
+            row[0] += synced_n
+            row[1] += group.size()
+
+        anchor = group.node_names[0] if group.node_names else group.id
+        crashed = [n for n in order if failing[n]]
+        if crashed:
+            # Rollback: unwind every artifact whose step had been
+            # reached, newest first (reverse topological order), then
+            # take the classic crash-loop edge to FAILED — one group
+            # transition, exactly the existing state machine.
+            first = crashed[0]
+            reached = [n for n in order if levels[n] <= levels[first]]
+            unwind = [n for n in dag.rollback_order() if n in reached]
+            logger.info(
+                "artifact %s crash-looping in group %s; unwinding %s",
+                first,
+                group.id,
+                unwind,
+            )
+            self.artifact_rollbacks_total += 1
+            log_event(
+                self.event_recorder,
+                anchor,
+                EVENT_TYPE_WARNING,
+                "ArtifactRollback",
+                f"group {group.id}: artifact {first!r} crash-looping "
+                "after restart (nodes: "
+                f"{', '.join(failing[first])}); unwinding in reverse "
+                f"topological order: {', '.join(unwind)}",
+            )
+            for i, name in enumerate(unwind):
+                log_event(
+                    self.event_recorder,
+                    anchor,
+                    EVENT_TYPE_NORMAL,
+                    "ArtifactRollbackStep",
+                    f"group {group.id}: unwind {i + 1}/{len(unwind)}: "
+                    f"artifact {name!r} (step {levels[name]})",
+                )
+            self._drop_artifact_gate_state(group.id)
+            self.provider.change_nodes_upgrade_state(
+                group.nodes, UpgradeState.FAILED
+            )
+            return
+
+        def artifact_ready(name: str) -> bool:
+            return synced_count[name] == group.size() and (
+                self._artifact_gate_passed(group, dag.artifact(name), name)
+            )
+
+        incomplete = [n for n in order if not artifact_ready(n)]
+        tr = self.trace_recorder
+        if incomplete:
+            cursor = min(levels[n] for n in incomplete)
+            for name in order:
+                pods = restartable[name]
+                if not pods:
+                    if tr is not None and levels[name] < cursor:
+                        tr.artifact_step(group, name, done=True)
+                    continue
+                if levels[name] > cursor:
+                    # Pinned-order skew hold: an earlier step is not
+                    # complete, so this artifact's outdated pods stay
+                    # on the old version inside the same window.
+                    self.artifact_skew_holds[name] = (
+                        self.artifact_skew_holds.get(name, 0) + 1
+                    )
+                    logger.info(
+                        "artifact %s of group %s held at step %d "
+                        "(cursor at step %d)",
+                        name,
+                        group.id,
+                        levels[name],
+                        cursor,
+                    )
+                    continue
+                if tr is not None:
+                    tr.artifact_step(group, name)
+                self.pod_manager.schedule_pods_restart(pods)
+            return
+        # The whole stack is synced and gated: close the artifact spans,
+        # drop per-group gate state, count the windows the shared pass
+        # avoided (k-artifact stack, one window instead of k), and take
+        # the classic advance path.
+        if tr is not None:
+            for name in order:
+                tr.artifact_step(group, name, done=True)
+        self._drop_artifact_gate_state(group.id)
+        all_ready = all(
+            self._is_driver_pod_in_sync(m) for m in group.members
+        ) and all(
+            self._artifact_pod_ready(m, name)
+            for m in group.members
+            for name in order[1:]
+        )
+        if all_ready:
+            self.artifact_window_savings += group.size() * (dag.size() - 1)
+        self._advance_restart_synced_group(
+            group, validation_active, pipeline, all_ready
+        )
 
     def process_upgrade_failed_groups(
         self,
@@ -2851,6 +3156,92 @@ class ClusterUpgradeStateManager:
             member.driver_daemon_set
         )
         return pod_hash == ds_hash, False
+
+    def _artifact_in_sync(
+        self, member: NodeUpgradeState, name: str
+    ) -> tuple[bool, bool]:
+        """(synced, orphaned) for a NON-primary artifact's pod on this
+        member, by the same controller-revision-hash comparison as the
+        primary.  A node carrying no pod for the artifact is vacuously
+        synced — the artifact's DaemonSet simply does not schedule
+        there, exactly how the classic path treats such a node."""
+        art = member.artifact_state(name)
+        if art is None or art.pod is None:
+            return True, False
+        if art.daemon_set is None:
+            return False, True
+        pod_hash = self.pod_manager.get_pod_controller_revision_hash(art.pod)
+        ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
+            art.daemon_set
+        )
+        return pod_hash == ds_hash, False
+
+    def _artifact_pod_ready(self, member: NodeUpgradeState, name: str) -> bool:
+        """Synced + Running + all containers ready, artifact edition."""
+        synced, orphaned = self._artifact_in_sync(member, name)
+        if orphaned or not synced:
+            return False
+        art = member.artifact_state(name)
+        if art is None or art.pod is None:
+            return True  # vacuously ready: nothing scheduled here
+        pod = art.pod
+        return pod.status.phase == "Running" and pod.all_containers_ready()
+
+    def _artifact_gate_passed(
+        self, group: UpgradeGroup, artifact, name: str
+    ) -> bool:
+        """Per-artifact validation gate inside the window.  No gate or
+        no wired prober passes vacuously; a wired prober's healthy
+        verdict is cached per (group, artifact) for the life of the
+        step (in-memory only — a restarted controller re-probes, the
+        safe direction).  Not-passed holds the stack at this step and
+        counts into artifact_gate_holds."""
+        gate = getattr(artifact, "gate", "") or ""
+        if not gate:
+            return True
+        prober = self.artifact_gate_prober
+        if prober is None:
+            return True
+        key = (group.id, name)
+        if key in self._artifact_gate_ok:
+            return True
+        verdict = prober.probe(group, name)
+        if getattr(verdict, "passed", False):
+            self._artifact_gate_ok.add(key)
+            self._artifact_gate_warned.discard(key)
+            return True
+        self.artifact_gate_holds[name] = (
+            self.artifact_gate_holds.get(name, 0) + 1
+        )
+        detail = getattr(verdict, "detail", "")
+        logger.info(
+            "artifact %s of group %s held by %s gate: %s",
+            name,
+            group.id,
+            gate,
+            detail,
+        )
+        if key not in self._artifact_gate_warned:
+            # One Warning per hold episode, not per pass.
+            self._artifact_gate_warned.add(key)
+            anchor = group.node_names[0] if group.node_names else group.id
+            log_event(
+                self.event_recorder,
+                anchor,
+                EVENT_TYPE_WARNING,
+                "ArtifactGateHeld",
+                f"group {group.id}: artifact {name!r} {gate} gate not "
+                f"passed: {detail}",
+            )
+        return False
+
+    def _drop_artifact_gate_state(self, group_id: str) -> None:
+        for key in list(self._artifact_gate_ok):
+            if key[0] == group_id:
+                self._artifact_gate_ok.discard(key)
+        for key in list(self._artifact_gate_warned):
+            if key[0] == group_id:
+                self._artifact_gate_warned.discard(key)
 
     def _is_driver_pod_in_sync(self, member: NodeUpgradeState) -> bool:
         """Synced + Running + all containers ready (upgrade_state.go:936-964)."""

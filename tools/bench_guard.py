@@ -261,6 +261,22 @@ FED_PARTITION_TICKS = 20
 FED_STORE_WRITE_CEILING = 8
 FED_MAX_TICKS = 600
 
+# Multi-artifact stage: the shared-window pins.  The same 256-node
+# fleet (64 slices x 4 hosts, every node carrying a driver + network
+# driver + device-plugin pod) is rolled twice — once under a classic
+# single-DaemonSet policy, once under a 3-artifact pinned-order stack
+# (driver -> net -> plugin) — and the stack roll must amortize ONE
+# window per node: exactly 1 cordon and 1 drain-window entry per node,
+# exactly 1 BudgetLedger charge per slice group for the whole stack,
+# and the per-verb API write delta versus the classic roll must be
+# EXACTLY the two extra artifacts' own pod restarts (2 deletes + the
+# DS-controller's 2 recreates per node) — zero extra node patches,
+# events, or any other write verb per additional artifact.
+MULTI_ART_N_SLICES = 64
+MULTI_ART_HOSTS_PER_SLICE = 4
+MULTI_ART_EXTRA_ARTIFACTS = 2  # net + plugin ride the driver's window
+MULTI_ART_MAX_TICKS = 400
+
 
 def measure(
     slices: int = N_SLICES,
@@ -2196,6 +2212,272 @@ def measure_federation(
     }
 
 
+# Write verbs compared between the classic and stack rolls.  Reads are
+# deliberately absent: the pin is "no extra API *writes* per artifact",
+# and read traffic is covered by the cached-reconcile stage.
+MULTI_ART_WRITE_VERBS = (
+    "patch_node",
+    "delete_pod",
+    "evict_pod",
+    "update_pod",
+    "create_pod",
+    "create_event",
+    "update_daemon_set",
+    "create_node",
+    "delete_node",
+)
+
+
+def _multi_artifact_roll(multi: bool) -> dict:
+    """One 256-node roll on a fresh fleet: classic single-DaemonSet
+    policy (``multi=False``) or the 3-artifact pinned-order stack
+    (``multi=True``).  Both fleets carry identical objects — the
+    network-driver and device-plugin pods exist (and their DaemonSets
+    are bumped) either way, so the per-verb write counts differ only by
+    what the stack itself does."""
+    import time
+
+    from k8s_operator_libs_tpu.api import IntOrString, TPUUpgradePolicySpec
+    from k8s_operator_libs_tpu.api.v1alpha1 import (
+        ArtifactDAGSpec,
+        ArtifactEdgeSpec,
+        ArtifactSpec,
+    )
+    from k8s_operator_libs_tpu.k8s import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        UpgradeKeys,
+    )
+    from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+    from k8s_operator_libs_tpu.upgrade.sharded import BudgetLedger
+
+    from fixtures import ClusterFixture, DRIVER_LABELS, NAMESPACE
+
+    net_labels = {"app": "tpu-network-driver"}
+    plugin_labels = {"app": "tpu-device-plugin"}
+
+    keys = UpgradeKeys()
+    cluster = FakeCluster()
+    fx = ClusterFixture(cluster, keys)
+    driver_ds = fx.daemon_set(hash_suffix="v1", revision=1)
+    net_ds = fx.daemon_set(
+        name="tpu-net", hash_suffix="net-v1", revision=1, labels=net_labels
+    )
+    plugin_ds = fx.daemon_set(
+        name="tpu-plugin",
+        hash_suffix="plug-v1",
+        revision=1,
+        labels=plugin_labels,
+    )
+    nodes = []
+    for i in range(MULTI_ART_N_SLICES):
+        for n in fx.tpu_slice(f"pool-{i}", hosts=MULTI_ART_HOSTS_PER_SLICE):
+            nodes.append(n)
+            fx.driver_pod(n, driver_ds, hash_suffix="v1")
+            fx.driver_pod(
+                n, net_ds, hash_suffix="net-v1", name=f"net-{n.name}"
+            )
+            fx.driver_pod(
+                n, plugin_ds, hash_suffix="plug-v1", name=f"plugin-{n.name}"
+            )
+    for ds, suffix in (
+        (driver_ds, "v2"),
+        (net_ds, "net-v2"),
+        (plugin_ds, "plug-v2"),
+    ):
+        fx.bump_daemon_set_template(ds, suffix, revision=2)
+        fx.auto_recreate_driver_pods(ds, suffix)
+
+    artifacts = None
+    if multi:
+        artifacts = ArtifactDAGSpec(
+            items=[
+                ArtifactSpec(
+                    name="driver",
+                    match_labels=dict(DRIVER_LABELS),
+                    target_version="2.18.0",
+                ),
+                ArtifactSpec(
+                    name="net",
+                    match_labels=dict(net_labels),
+                    target_version="1.4.0",
+                ),
+                ArtifactSpec(
+                    name="plugin",
+                    match_labels=dict(plugin_labels),
+                    target_version="0.9.2",
+                ),
+            ],
+            edges=[
+                ArtifactEdgeSpec(
+                    before="driver",
+                    after="net",
+                    requires=">=2.18.0",
+                    skew="pinned-order",
+                ),
+                ArtifactEdgeSpec(
+                    before="net", after="plugin", skew="pinned-order"
+                ),
+            ],
+        )
+    policy = TPUUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        unavailability_unit="slice",
+        artifacts=artifacts,
+    )
+    policy.validate()
+
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys=keys, poll_interval_s=0.005, poll_timeout_s=5.0
+    )
+
+    # One BudgetLedger charge per group for the WHOLE stack: count
+    # charge events (a grant to a group not currently holding one).
+    ledger = BudgetLedger()
+    ledger.configure(
+        total_units=MULTI_ART_N_SLICES,
+        max_parallel=0,
+        max_unavailable=MULTI_ART_N_SLICES,
+        unit="slice",
+    )
+    charges: dict[str, int] = {}
+    orig_claim = ledger.try_claim
+
+    def counting_claim(group_id, cost, **kw):
+        held = ledger.holds(group_id)
+        ok = orig_claim(group_id, cost, **kw)
+        if ok and not held:
+            charges[group_id] = charges.get(group_id, 0) + 1
+        return ok
+
+    ledger.try_claim = counting_claim
+    mgr.budget_ledger = ledger
+
+    cordons: dict[str, int] = {}
+    orig_unsched = cluster.set_node_unschedulable
+
+    def counting_unsched(name, unschedulable):
+        if unschedulable:
+            cordons[name] = cordons.get(name, 0) + 1
+        return orig_unsched(name, unschedulable)
+
+    cluster.set_node_unschedulable = counting_unsched
+
+    # Drain-window entries: state-label writes flipping a node into the
+    # drain state, on both label write paths (plain and coalesced).
+    drain_value = UpgradeState.DRAIN_REQUIRED.value
+    drains: dict[str, int] = {}
+
+    def watch_labels(name, labels):
+        if (labels or {}).get(keys.state_label) == drain_value:
+            drains[name] = drains.get(name, 0) + 1
+
+    orig_patch_labels = cluster.patch_node_labels
+    orig_patch_meta = cluster.patch_node_metadata
+
+    def counting_patch_labels(name, patch):
+        watch_labels(name, patch)
+        return orig_patch_labels(name, patch)
+
+    def counting_patch_meta(
+        name, labels=None, annotations=None, field_manager=None
+    ):
+        watch_labels(name, labels)
+        return orig_patch_meta(
+            name,
+            labels=labels,
+            annotations=annotations,
+            field_manager=field_manager,
+        )
+
+    cluster.patch_node_labels = counting_patch_labels
+    cluster.patch_node_metadata = counting_patch_meta
+
+    write_base = {v: cluster.stats.get(v, 0) for v in MULTI_ART_WRITE_VERBS}
+    t0 = time.monotonic()
+    converged = False
+    for tick in range(MULTI_ART_MAX_TICKS):
+        state = mgr.build_state(NAMESPACE, DRIVER_LABELS, policy)
+        mgr.apply_state(state, policy)
+        mgr.wait_for_async_work(60.0)
+        states = {
+            cluster.get_node(n.name, cached=False).labels.get(
+                keys.state_label, ""
+            )
+            for n in nodes
+        }
+        if states == {UpgradeState.DONE.value}:
+            converged = True
+            break
+    wall_s = time.monotonic() - t0
+
+    return {
+        "converged": converged,
+        "ticks": tick + 1,
+        "wall_s": round(wall_s, 3),
+        "nodes": len(nodes),
+        "groups": MULTI_ART_N_SLICES,
+        "cordons_per_node": sorted(set(cordons.values())) or [0],
+        "nodes_cordoned": len(cordons),
+        "drains_per_node": sorted(set(drains.values())) or [0],
+        "nodes_drained": len(drains),
+        "charges_per_group": sorted(set(charges.values())) or [0],
+        "groups_charged": len(charges),
+        "writes": {
+            v: cluster.stats.get(v, 0) - write_base[v]
+            for v in MULTI_ART_WRITE_VERBS
+        },
+        "window_savings": mgr.artifact_window_savings,
+        "skew_holds": dict(mgr.artifact_skew_holds),
+    }
+
+
+def measure_multi_artifact() -> dict:
+    """Multi-artifact stage: a 3-artifact pinned-order stack (driver ->
+    net -> plugin) over a 256-node fleet must share ONE cordon/drain
+    window and ONE budget charge per group, and its per-verb API write
+    delta versus the identical classic roll must be exactly the extra
+    artifacts' own pod restarts — nothing else."""
+    classic = _multi_artifact_roll(multi=False)
+    stack = _multi_artifact_roll(multi=True)
+    delta = {
+        v: stack["writes"][v] - classic["writes"][v]
+        for v in MULTI_ART_WRITE_VERBS
+    }
+    extra_restarts = stack["nodes"] * MULTI_ART_EXTRA_ARTIFACTS
+    return {
+        "stage": "multi_artifact",
+        "nodes": stack["nodes"],
+        "groups": stack["groups"],
+        "artifacts": 1 + MULTI_ART_EXTRA_ARTIFACTS,
+        "converged": classic["converged"] and stack["converged"],
+        "classic_ticks": classic["ticks"],
+        "stack_ticks": stack["ticks"],
+        "classic_wall_s": classic["wall_s"],
+        "stack_wall_s": stack["wall_s"],
+        "cordons_per_node": stack["cordons_per_node"],
+        "nodes_cordoned": stack["nodes_cordoned"],
+        "drains_per_node": stack["drains_per_node"],
+        "nodes_drained": stack["nodes_drained"],
+        "charges_per_group": stack["charges_per_group"],
+        "groups_charged": stack["groups_charged"],
+        "write_delta": {k: v for k, v in delta.items() if v},
+        "expected_extra_pod_restarts": extra_restarts,
+        "extra_writes_clean": delta
+        == {
+            **{v: 0 for v in MULTI_ART_WRITE_VERBS},
+            # The stack restarts each extra artifact's pod once per
+            # node; the fixture's DS-controller hook recreates it.
+            "delete_pod": extra_restarts,
+            "create_pod": extra_restarts,
+        },
+        "window_savings": stack["window_savings"],
+        "skew_holds": stack["skew_holds"],
+    }
+
+
 def main() -> int:
     result = measure()
     ok = result["api_requests_per_tick"] <= API_PER_TICK_CEILING
@@ -2674,6 +2956,67 @@ def main() -> int:
     if failures:
         for f in failures:
             print(f"bench-guard FAIL (federation): {f}", file=sys.stderr)
+        return 1
+
+    multi_artifact = measure_multi_artifact()
+    failures = []
+    if not multi_artifact["converged"]:
+        failures.append(
+            "a roll did not converge to upgrade-done "
+            f"(classic {multi_artifact['classic_ticks']} ticks, stack "
+            f"{multi_artifact['stack_ticks']} ticks)"
+        )
+    if multi_artifact["cordons_per_node"] != [1] or multi_artifact[
+        "nodes_cordoned"
+    ] != multi_artifact["nodes"]:
+        failures.append(
+            f"stack roll cordoned {multi_artifact['nodes_cordoned']} "
+            f"node(s) {multi_artifact['cordons_per_node']} time(s) each "
+            f"(must be every node exactly once — the shared window "
+            "split)"
+        )
+    if multi_artifact["drains_per_node"] != [1] or multi_artifact[
+        "nodes_drained"
+    ] != multi_artifact["nodes"]:
+        failures.append(
+            f"stack roll entered the drain window "
+            f"{multi_artifact['drains_per_node']} time(s) on "
+            f"{multi_artifact['nodes_drained']} node(s) (must be every "
+            "node exactly once)"
+        )
+    if multi_artifact["charges_per_group"] != [1] or multi_artifact[
+        "groups_charged"
+    ] != multi_artifact["groups"]:
+        failures.append(
+            f"stack roll charged {multi_artifact['groups_charged']} "
+            f"group(s) {multi_artifact['charges_per_group']} time(s) "
+            "each (must be one BudgetLedger charge per group for the "
+            "whole stack)"
+        )
+    if not multi_artifact["extra_writes_clean"]:
+        failures.append(
+            f"write delta vs the classic roll is "
+            f"{multi_artifact['write_delta']} (must be exactly "
+            f"{multi_artifact['expected_extra_pod_restarts']} pod "
+            "deletes + recreates — an extra artifact leaked node "
+            "patches, events, or other writes)"
+        )
+    if multi_artifact["window_savings"] != (
+        multi_artifact["nodes"] * MULTI_ART_EXTRA_ARTIFACTS
+    ):
+        failures.append(
+            f"shared-window savings counter "
+            f"{multi_artifact['window_savings']} != nodes x extra "
+            f"artifacts ({multi_artifact['nodes']} x "
+            f"{MULTI_ART_EXTRA_ARTIFACTS})"
+        )
+    multi_artifact["ok"] = not failures
+    print(json.dumps(multi_artifact, sort_keys=True))
+    if failures:
+        for f in failures:
+            print(
+                f"bench-guard FAIL (multi-artifact): {f}", file=sys.stderr
+            )
         return 1
 
     # Deliberately LAST: the 100k-node fixture churns ~2 GiB of heap,
